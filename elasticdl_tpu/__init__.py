@@ -1,0 +1,33 @@
+"""elasticdl_tpu — a TPU-native elastic distributed deep-learning framework.
+
+A ground-up rebuild of the capabilities of ElasticDL (reference:
+Kelang-Tian/elasticdl, a Kubernetes-native elastic training framework built on
+TensorFlow/Horovod/gRPC parameter servers) designed TPU-first:
+
+- Synchronous data parallelism is a single jitted train step with
+  ``jax.lax.pmean`` gradient sync over an ICI ``jax.sharding.Mesh``
+  (replacing the reference's Horovod/NCCL allreduce rings).
+- The parameter-server sparse embedding layer becomes an HBM-sharded
+  embedding table with collective lookups over the mesh (replacing the
+  reference's gRPC pull_embedding_vectors/push_gradients round trips).
+- Elastic worker join/leave re-forms the device mesh from a checkpoint
+  (replacing the reference's Horovod elastic re-rendezvous).
+- A master dynamically shards data into tasks dispatched over gRPC so a
+  preempted worker loses no work (same control-plane design as the
+  reference, reimplemented).
+
+Layout:
+- ``elasticdl_tpu.common``   — config/flags, logging, constants.
+- ``elasticdl_tpu.models``   — model contract + model zoo (mnist, cifar10
+  resnet, census wide&deep, criteo deepfm).
+- ``elasticdl_tpu.ops``      — sharded embedding, pallas kernels.
+- ``elasticdl_tpu.parallel`` — mesh management, trainers (AllReduce/PS-hybrid).
+- ``elasticdl_tpu.master``   — task dispatcher, gRPC servicer, rendezvous,
+  pod manager, evaluation service.
+- ``elasticdl_tpu.worker``   — worker main loop.
+- ``elasticdl_tpu.data``     — data readers (CSV, recordio-style, synthetic).
+- ``elasticdl_tpu.ps``       — native C++ parameter-server store + kernels
+  (host-side, for parity with the reference's Go PS).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,172 @@
+"""Job configuration — the cross-process "config bus".
+
+The reference (ElasticDL) uses a layered argparse flag set
+(``elasticdl/python/common/args.py`` [U: mount empty at survey time]) that the
+client validates, the master re-parses, and the master serializes into worker /
+PS pod command lines.  We keep the same pattern with one typed dataclass that
+(a) parses from the same flag names the reference exposes
+(``--distribution_strategy``, ``--model_zoo``, ``--model_def``,
+``--minibatch_size``, ...), and (b) round-trips losslessly through a JSON
+environment variable so the master can hand it to worker pods.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+
+class DistributionStrategy:
+    """Mirrors the reference's --distribution_strategy values.
+
+    In the TPU rebuild both strategies compile to a single jitted step over a
+    mesh; the difference is how *sparse* parameters are laid out:
+
+    - ALLREDUCE: all params replicated, grads pmean'd over the ``dp`` axis.
+    - PARAMETER_SERVER: embedding tables row-sharded over the mesh (the
+      HBM-resident "parameter server"), dense params replicated + pmean.
+      Lookups are collective (all_gather ids + reduce_scatter vectors)
+      instead of the reference's gRPC pull/push.
+    - LOCAL: single device, no collectives (reference's Local mode).
+    """
+
+    LOCAL = "Local"
+    ALLREDUCE = "AllReduce"
+    PARAMETER_SERVER = "ParameterServer"
+
+    ALL = (LOCAL, ALLREDUCE, PARAMETER_SERVER)
+
+
+@dataclasses.dataclass
+class JobConfig:
+    """All knobs for one training/evaluation/prediction job."""
+
+    # --- model zoo contract (reference: --model_zoo / --model_def) ---
+    model_zoo: str = "elasticdl_tpu.models"
+    model_def: str = "mnist.model_spec"
+    model_params: str = ""  # free-form "k=v;k=v" forwarded to the model fn
+
+    # --- job identity / mode ---
+    job_name: str = "elasticdl-job"
+    job_type: str = "training"  # training | evaluation | prediction
+    distribution_strategy: str = DistributionStrategy.ALLREDUCE
+
+    # --- data (reference: --training_data / --validation_data etc.) ---
+    training_data: str = ""
+    validation_data: str = ""
+    prediction_data: str = ""
+    data_reader_params: str = ""
+
+    # --- schedule ---
+    minibatch_size: int = 64
+    num_epochs: int = 1
+    num_minibatches_per_task: int = 8  # shard granularity, as in the reference
+    max_steps: int = 0  # 0 = until tasks exhausted
+    evaluation_steps: int = 0  # 0 = eval at epoch end only
+    learning_rate: float = 1e-3
+
+    # --- cluster shape ---
+    num_workers: int = 1
+    num_ps_shards: int = 0  # 0 = shard embeddings over all mesh devices
+    use_tpu: bool = True
+
+    # --- elasticity ---
+    relaunch_on_worker_failure: bool = True
+    max_worker_relaunch: int = 3
+
+    # --- checkpoint (reference: --checkpoint_steps / --checkpoint_dir) ---
+    checkpoint_steps: int = 0
+    checkpoint_dir: str = ""
+    keep_checkpoint_max: int = 3
+
+    # --- master / control plane ---
+    master_addr: str = ""  # host:port of the master gRPC service
+    task_timeout_s: float = 600.0
+
+    # --- observability ---
+    log_level: str = "INFO"
+    profile_dir: str = ""
+
+    # --- precision ---
+    compute_dtype: str = "bfloat16"  # MXU-native; params stay f32
+
+    def validate(self) -> None:
+        if self.distribution_strategy not in DistributionStrategy.ALL:
+            raise ValueError(
+                f"--distribution_strategy must be one of "
+                f"{DistributionStrategy.ALL}, got {self.distribution_strategy!r}"
+            )
+        if self.minibatch_size <= 0:
+            raise ValueError("--minibatch_size must be positive")
+        if self.num_minibatches_per_task <= 0:
+            raise ValueError("--num_minibatches_per_task must be positive")
+        if self.job_type not in ("training", "evaluation", "prediction"):
+            raise ValueError(f"unknown job_type {self.job_type!r}")
+
+    # -- serialization: the config bus between master and worker pods --
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), sort_keys=True)
+
+    @classmethod
+    def from_json(cls, payload: str) -> "JobConfig":
+        raw = json.loads(payload)
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in raw.items() if k in known})
+
+    def to_env(self) -> Dict[str, str]:
+        return {"ELASTICDL_JOB_CONFIG": self.to_json()}
+
+    @classmethod
+    def from_env(cls, environ: Optional[Dict[str, str]] = None) -> "JobConfig":
+        environ = os.environ if environ is None else environ
+        payload = environ.get("ELASTICDL_JOB_CONFIG")
+        if not payload:
+            raise KeyError("ELASTICDL_JOB_CONFIG not set")
+        return cls.from_json(payload)
+
+    def parsed_model_params(self) -> Dict[str, Any]:
+        return _parse_kv_string(self.model_params)
+
+    def parsed_data_reader_params(self) -> Dict[str, Any]:
+        return _parse_kv_string(self.data_reader_params)
+
+
+def _parse_kv_string(spec: str) -> Dict[str, Any]:
+    """Parse the reference-style "key=value;key=value" param strings."""
+    out: Dict[str, Any] = {}
+    for item in filter(None, (s.strip() for s in spec.split(";"))):
+        if "=" not in item:
+            raise ValueError(f"malformed param {item!r}, expected key=value")
+        key, value = item.split("=", 1)
+        try:
+            out[key.strip()] = json.loads(value)
+        except json.JSONDecodeError:
+            out[key.strip()] = value.strip()
+    return out
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    """Argparse surface mirroring the reference client's flag names."""
+    parser = argparse.ArgumentParser(prog="elasticdl", add_help=True)
+    for field in dataclasses.fields(JobConfig):
+        flag = "--" + field.name
+        if field.type == "bool" or isinstance(field.default, bool):
+            parser.add_argument(
+                flag,
+                type=lambda v: str(v).lower() in ("1", "true", "yes"),
+                default=field.default,
+            )
+        else:
+            parser.add_argument(flag, type=type(field.default), default=field.default)
+    return parser
+
+
+def parse_args(argv: Optional[List[str]] = None) -> JobConfig:
+    namespace = build_arg_parser().parse_args(argv)
+    config = JobConfig(**vars(namespace))
+    config.validate()
+    return config

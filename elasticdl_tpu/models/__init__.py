@@ -1,0 +1,16 @@
+"""Model zoo + model contract.
+
+The reference's model contract (model_zoo modules exporting
+``custom_model()/loss/optimizer/feed`` [U: mount empty at survey time,
+upstream layout]) is re-cast functionally for JAX: each model-zoo module
+exports ``model_spec(**params) -> ModelSpec`` — pure init/apply/loss/metrics
+functions plus an optax optimizer and embedding-table metadata so the trainer
+can shard sparse tables over the mesh.
+"""
+
+from elasticdl_tpu.models.spec import (  # noqa: F401
+    EmbeddingTableSpec,
+    ModelSpec,
+    load_model_spec,
+    load_model_spec_for_job,
+)

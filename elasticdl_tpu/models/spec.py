@@ -1,0 +1,102 @@
+"""The model contract: what a model-zoo entry must provide.
+
+Reference parity: ElasticDL loads a user module from ``--model_zoo`` /
+``--model_def`` and expects ``custom_model()`` (a Keras model), ``loss``,
+``optimizer``, ``feed`` plus optional ``eval_metrics_fn`` [U — upstream
+contract; fork mount was empty at survey time].  Here the same roles are pure
+functions over pytrees so the whole step jits:
+
+- ``init(rng) -> params``                 ~ custom_model() variable creation
+- ``apply(params, batch, train) -> out``  ~ model.call
+- ``loss(out, batch) -> scalar``          ~ loss
+- ``metrics(out, batch) -> dict``         ~ eval_metrics_fn
+- ``optimizer``                           ~ optimizer (optax)
+- ``feed(records) -> batch``              ~ feed / dataset_fn
+- ``embedding_tables``                    ~ elasticdl.layers.Embedding usage:
+  names of params that are sparse embedding tables, which the
+  ParameterServer strategy shards row-wise over the mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+Params = Any  # pytree
+Batch = Any  # pytree of arrays
+
+
+@dataclasses.dataclass(frozen=True)
+class EmbeddingTableSpec:
+    """Declares one mesh-sharded embedding table inside the param pytree.
+
+    ``path`` addresses the table array in the params pytree (tuple of keys).
+    The table is **div-sharded** by row over the mesh's embedding axis: with
+    ``n`` shards and padded vocab ``V'``, shard ``i`` owns contiguous rows
+    ``[i*V'/n, (i+1)*V'/n)`` (GSPMD's natural layout of a global array — see
+    ``elasticdl_tpu.ops.embedding``).  This plays the role of the reference
+    PS's partitioned embedding KV store; load balance across shards is
+    irrelevant here because the collective lookup does uniform masked work on
+    every device regardless of the id distribution.
+    """
+
+    path: Tuple[str, ...]
+    vocab_size: int
+    dim: int
+
+
+@dataclasses.dataclass
+class ModelSpec:
+    name: str
+    init: Callable[..., Params]  # (rng) -> params
+    apply: Callable[..., Any]  # (params, batch, train=bool) -> outputs
+    loss: Callable[[Any, Batch], Any]  # (outputs, batch) -> scalar
+    metrics: Callable[[Any, Batch], Dict[str, Any]]
+    optimizer: Any  # optax.GradientTransformation
+    feed: Optional[Callable[[Sequence[bytes]], Batch]] = None
+    embedding_tables: List[EmbeddingTableSpec] = dataclasses.field(
+        default_factory=list
+    )
+    # Example batch (tiny) for compile checks / shape inference.
+    example_batch: Optional[Callable[[int], Batch]] = None
+
+
+def load_model_spec(model_zoo: str, model_def: str, **params: Any) -> ModelSpec:
+    """Load ``model_spec`` from a zoo module.
+
+    ``model_def`` is "module.function" relative to the ``model_zoo`` package,
+    mirroring the reference's ``--model_zoo``/``--model_def`` resolution.
+    """
+    module_name, _, fn_name = model_def.rpartition(".")
+    if not module_name:
+        raise ValueError(
+            f"--model_def must look like 'module.function', got {model_def!r}"
+        )
+    module = importlib.import_module(f"{model_zoo}.{module_name}")
+    fn = getattr(module, fn_name)
+    spec = fn(**params)
+    if not isinstance(spec, ModelSpec):
+        raise TypeError(f"{model_def} returned {type(spec)}, expected ModelSpec")
+    return spec
+
+
+def load_model_spec_for_job(config: Any) -> ModelSpec:
+    """Load the model for a JobConfig, plumbing job-level knobs.
+
+    ``--learning_rate`` / ``--compute_dtype`` flags are forwarded to the model
+    fn when it accepts them; explicit ``--model_params`` entries win (same
+    precedence the reference gives model-module definitions over defaults).
+    """
+    import inspect
+
+    params: dict = {}
+    module_name, _, fn_name = config.model_def.rpartition(".")
+    module = importlib.import_module(f"{config.model_zoo}.{module_name}")
+    accepted = inspect.signature(getattr(module, fn_name)).parameters
+    if "learning_rate" in accepted:
+        params["learning_rate"] = config.learning_rate
+    if "compute_dtype" in accepted:
+        params["compute_dtype"] = config.compute_dtype
+    params.update(config.parsed_model_params())
+    return load_model_spec(config.model_zoo, config.model_def, **params)

@@ -1,0 +1,5 @@
+from elasticdl_tpu.ops.embedding import (  # noqa: F401
+    ParallelContext,
+    embedding_lookup,
+    pad_vocab,
+)

@@ -1,0 +1,100 @@
+"""Mesh-sharded embedding lookup — the TPU-native replacement for the
+reference's gRPC parameter-server embedding path (``elasticdl.layers.
+Embedding`` pulling vectors / pushing IndexedSlices grads over gRPC
+[D: BASELINE.json north_star]; reference sources unverifiable, mount empty at
+survey time).
+
+Design (static shapes, XLA/ICI-friendly — see SURVEY.md §7 item 5):
+
+- The table is **row-sharded** over the mesh axis: with ``n`` shards and a
+  padded vocab ``V'`` (multiple of ``n``), shard ``i`` owns contiguous rows
+  ``[i*V'/n, (i+1)*V'/n)``.  This is GSPMD's natural div-sharding of a global
+  ``[V', D]`` array, so the same array is addressable both outside shard_map
+  (as one logical array for checkpointing) and inside (as the local shard).
+- Forward, per device: ``all_gather`` every device's ids (tiny int32
+  traffic), gather the rows this shard owns (masked, uniform compute — load
+  is balanced regardless of id distribution), then ``psum_scatter`` the
+  vectors so each device receives exactly its own batch's embeddings, summed
+  across shards (exactly one shard contributed each row).  Vector traffic
+  crosses ICI once — the same volume a ragged all-to-all would move.
+- Backward is pure JAX AD: the transpose of ``psum_scatter`` is
+  ``all_gather`` of the cotangents and the transpose of the masked gather is
+  a scatter-add into the local shard — the moral equivalent of the
+  reference's server-side IndexedSlices apply, with duplicate ids correctly
+  accumulated.
+
+Optimizer state for the table is co-sharded automatically because optax maps
+leaf-wise (each shard's Adam moments live next to its rows — like the
+reference's per-PS-pod Go optimizer state).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# Pad vocabularies to a multiple of this so the padded size divides every
+# power-of-two mesh size up to a v5e-256 pod; table shapes then stay identical
+# across elastic resizes (4->8->4 never reshapes params or optimizer state).
+DEFAULT_VOCAB_MULTIPLE = 256
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelContext:
+    """Trace-time description of how the current step is parallelized.
+
+    Passed by the trainer into ``ModelSpec.apply`` so embedding ops know
+    whether tables are mesh-sharded (ParameterServer strategy) or replicated
+    (AllReduce/Local).  ``axis_name`` is the mesh axis the step runs under
+    (None when not inside shard_map).
+    """
+
+    axis_name: Optional[str] = None
+    sharded_embeddings: bool = False
+
+
+def pad_vocab(vocab_size: int, multiple: int = DEFAULT_VOCAB_MULTIPLE) -> int:
+    return ((vocab_size + multiple - 1) // multiple) * multiple
+
+
+def embedding_lookup(
+    table: jax.Array, ids: jax.Array, ctx: ParallelContext
+) -> jax.Array:
+    """Look up ``ids`` in ``table``.
+
+    - Replicated mode: a plain gather (``table[ids]``).
+    - Sharded mode (inside shard_map): ``table`` is this device's local row
+      shard of the padded global table; collective lookup as described in the
+      module docstring.
+
+    ids may have any shape; output has shape ``ids.shape + (dim,)``.
+    """
+    if not (ctx.sharded_embeddings and ctx.axis_name):
+        return jnp.take(table, ids, axis=0)
+    return _sharded_lookup(table, ids, ctx.axis_name)
+
+
+def _sharded_lookup(local_table: jax.Array, ids: jax.Array, axis_name: str):
+    n = lax.axis_size(axis_name)
+    my_shard = lax.axis_index(axis_name)
+    rows_local, dim = local_table.shape
+
+    ids_shape = ids.shape
+    # [n, local_ids] — every device's flat id list.
+    all_ids = lax.all_gather(ids.reshape(-1), axis_name)
+    flat = all_ids.reshape(-1)
+
+    owner = flat // rows_local
+    local_row = flat - owner * rows_local
+    mine = owner == my_shard
+    safe_row = jnp.where(mine, local_row, 0)
+    vectors = jnp.where(mine[:, None], local_table[safe_row], 0)
+
+    # Route each device its own block, summing over shards (one nonzero each).
+    vectors = vectors.reshape(n, -1, dim)
+    out = lax.psum_scatter(vectors, axis_name, scatter_dimension=0, tiled=False)
+    return out.reshape(ids_shape + (dim,))

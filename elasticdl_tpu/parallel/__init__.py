@@ -1,0 +1,7 @@
+from elasticdl_tpu.parallel.mesh import MeshManager, create_mesh  # noqa: F401
+from elasticdl_tpu.parallel.trainer import (  # noqa: F401
+    Trainer,
+    TrainState,
+    build_eval_step,
+    build_train_step,
+)

@@ -1,0 +1,79 @@
+"""Device-mesh management — the TPU-native replacement for the reference's
+Horovod communicator (RendezvousServer + NCCL/Gloo ring [D: BASELINE.json
+north_star]; reference sources unverifiable, mount empty at survey time).
+
+Where the reference re-forms an NCCL ring when workers join/leave, we re-form
+a ``jax.sharding.Mesh`` over the currently-live devices.  The mesh is 1-D with
+axis ``"dp"``: data parallelism shards the batch over it, and (in
+ParameterServer strategy) embedding tables are row-sharded over the *same*
+axis — on TPU the "parameter server" is simply the HBM of the same chips that
+compute, and lookups ride ICI collectives instead of gRPC.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "dp"
+
+
+def create_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    num_devices: Optional[int] = None,
+    axis_name: str = DATA_AXIS,
+) -> Mesh:
+    """Build a 1-D mesh over ``devices`` (default: all local devices).
+
+    ``num_devices`` takes a prefix of the available devices — used by the
+    elastic path to form smaller meshes after a worker leaves, and by tests to
+    emulate 4->8->4 scaling on a fixed pool of fake CPU devices.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices, only {len(devices)} available"
+            )
+        devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+class MeshManager:
+    """Owns the current mesh and re-forms it on membership changes.
+
+    This is the worker-side half of elastic re-rendezvous: the master bumps a
+    membership version (see ``elasticdl_tpu.master.rendezvous``); when a worker
+    observes a new version it calls ``reform`` with the new world size, and the
+    trainer recompiles its step for the new mesh (compile caches make repeat
+    sizes cheap).
+    """
+
+    def __init__(self, devices: Optional[Sequence[jax.Device]] = None):
+        self._pool = list(devices) if devices is not None else list(jax.devices())
+        self._mesh: Optional[Mesh] = None
+        self._version = -1
+
+    @property
+    def mesh(self) -> Mesh:
+        if self._mesh is None:
+            self.reform(len(self._pool), version=0)
+        assert self._mesh is not None
+        return self._mesh
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    def reform(self, num_devices: int, version: int) -> Mesh:
+        self._mesh = create_mesh(self._pool, num_devices=num_devices)
+        self._version = version
+        return self._mesh
+
+    def num_devices(self) -> int:
+        return self.mesh.devices.size

@@ -1,0 +1,32 @@
+"""Test harness: 8 fake CPU devices, mirroring the reference's no-cluster test
+strategy (mock k8s + in-process master/worker — SURVEY.md §4) with JAX's
+equivalent: XLA host-platform device multiplexing.
+
+Must set the env vars BEFORE jax initializes its backends, hence this module
+does it at import time (conftest is imported before any test module).
+"""
+
+import os
+
+# Force-override: the image's sitecustomize registers the tunneled real-TPU
+# "axon" PJRT plugin at interpreter start and sets jax_platforms="axon,cpu",
+# which overrides the JAX_PLATFORMS env var.  Tests must run on fake CPU
+# devices (fast, 8-wide), so set XLA flags before backend init AND push the
+# config back to cpu after jax import.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 fake devices, got {len(devs)}"
+    return devs

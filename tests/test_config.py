@@ -1,0 +1,80 @@
+from elasticdl_tpu.common.config import (
+    DistributionStrategy,
+    JobConfig,
+    parse_args,
+)
+
+
+def test_defaults_valid():
+    cfg = JobConfig()
+    cfg.validate()
+
+
+def test_parse_reference_style_flags():
+    cfg = parse_args(
+        [
+            "--model_zoo", "elasticdl_tpu.models",
+            "--model_def", "mnist.model_spec",
+            "--distribution_strategy", "ParameterServer",
+            "--minibatch_size", "128",
+            "--num_epochs", "2",
+            "--num_workers", "4",
+            "--checkpoint_steps", "100",
+        ]
+    )
+    assert cfg.distribution_strategy == DistributionStrategy.PARAMETER_SERVER
+    assert cfg.minibatch_size == 128
+    assert cfg.num_workers == 4
+
+
+def test_json_roundtrip_env_bus():
+    cfg = JobConfig(minibatch_size=256, job_name="j1")
+    env = cfg.to_env()
+    restored = JobConfig.from_env(env)
+    assert restored == cfg
+
+
+def test_invalid_strategy_rejected():
+    import pytest
+
+    cfg = JobConfig(distribution_strategy="Horovod")
+    with pytest.raises(ValueError):
+        cfg.validate()
+
+
+def test_model_params_parsing():
+    cfg = JobConfig(model_params="learning_rate=0.01;hidden=[64, 32];name=deep")
+    parsed = cfg.parsed_model_params()
+    assert parsed == {"learning_rate": 0.01, "hidden": [64, 32], "name": "deep"}
+
+
+def test_learning_rate_flag_reaches_model():
+    import optax
+
+    from elasticdl_tpu.models import load_model_spec_for_job
+
+    cfg = JobConfig(model_def="mnist.model_spec", learning_rate=0.5)
+    spec = load_model_spec_for_job(cfg)
+    # The optimizer must have been built with the flag's LR, not the default.
+    params = {"w": __import__("jax.numpy", fromlist=["x"]).ones((2,))}
+    state = spec.optimizer.init(params)
+    grads = {"w": __import__("jax.numpy", fromlist=["x"]).ones((2,))}
+    updates, _ = spec.optimizer.update(grads, state, params)
+    assert abs(float(updates["w"][0])) == 0.5
+
+
+def test_model_params_override_learning_rate_flag():
+    from elasticdl_tpu.models import load_model_spec_for_job
+
+    cfg = JobConfig(
+        model_def="mnist.model_spec",
+        learning_rate=0.5,
+        model_params="learning_rate=0.25",
+    )
+    spec = load_model_spec_for_job(cfg)
+    params = {"w": __import__("jax.numpy", fromlist=["x"]).ones((2,))}
+    state = spec.optimizer.init(params)
+    updates, _ = spec.optimizer.update(
+        {"w": __import__("jax.numpy", fromlist=["x"]).ones((2,))}, state, params
+    )
+    assert abs(float(updates["w"][0])) == 0.25
