@@ -1,0 +1,176 @@
+"""ResNet-50 on CIFAR-10 — BASELINE.json config #2 ("ResNet-50 on CIFAR-10,
+AllReduce mode").
+
+Reference parity [D: config list; sources unverifiable — mount empty at survey
+time]: the reference trains a Keras ResNet via Horovod allreduce.  Rebuilt as
+a pure-JAX bottleneck ResNet whose whole train step jits over the mesh.
+
+TPU-first choices:
+- **GroupNorm instead of BatchNorm.**  BatchNorm's running stats are mutable
+  state and need cross-replica sync to be correct under data parallelism;
+  GroupNorm is the standard stat-free substitute on TPU pods (same accuracy
+  class on CIFAR) and keeps ``apply`` a pure function of the param pytree,
+  so the AllReduce step stays a single fused XLA program.
+- CIFAR stem (3x3 stride-1 conv, no maxpool) instead of the ImageNet 7x7/s2
+  stem, as is standard for 32x32 inputs.
+- Compute in bfloat16 (MXU), f32 params, f32 norm statistics.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from elasticdl_tpu.models.spec import ModelSpec
+
+NUM_CLASSES = 10
+IMAGE_SHAPE = (32, 32, 3)
+
+
+def _conv_init(rng, shape):
+    return jax.nn.initializers.he_normal()(rng, shape, jnp.float32)
+
+
+def _conv(x, w, stride=1):
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, ("NHWC", "HWIO", "NHWC"))
+    return jax.lax.conv_general_dilated(
+        x, w, (stride, stride), "SAME", dimension_numbers=dn
+    )
+
+
+def _group_norm(x, scale, bias, groups=8, eps=1e-5):
+    b, h, w, c = x.shape
+    g = min(groups, c)
+    xf = x.astype(jnp.float32).reshape(b, h, w, g, c // g)
+    mean = xf.mean(axis=(1, 2, 4), keepdims=True)
+    var = xf.var(axis=(1, 2, 4), keepdims=True)
+    xf = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (xf.reshape(b, h, w, c) * scale + bias).astype(x.dtype)
+
+
+def _init_block(rng, in_ch: int, mid_ch: int, stride: int) -> Dict[str, Any]:
+    out_ch = mid_ch * 4
+    ks = jax.random.split(rng, 4)
+    block = {
+        "conv1": _conv_init(ks[0], (1, 1, in_ch, mid_ch)),
+        "gn1": {"scale": jnp.ones((mid_ch,)), "bias": jnp.zeros((mid_ch,))},
+        "conv2": _conv_init(ks[1], (3, 3, mid_ch, mid_ch)),
+        "gn2": {"scale": jnp.ones((mid_ch,)), "bias": jnp.zeros((mid_ch,))},
+        "conv3": _conv_init(ks[2], (1, 1, mid_ch, out_ch)),
+        # Zero-init the last norm scale: residual branches start as identity,
+        # the standard trick for stable large-batch training.
+        "gn3": {"scale": jnp.zeros((out_ch,)), "bias": jnp.zeros((out_ch,))},
+    }
+    if stride != 1 or in_ch != out_ch:
+        block["proj"] = _conv_init(ks[3], (1, 1, in_ch, out_ch))
+        block["gn_proj"] = {"scale": jnp.ones((out_ch,)), "bias": jnp.zeros((out_ch,))}
+    return block
+
+
+def _apply_block(params, x, stride: int):
+    y = _conv(x, params["conv1"].astype(x.dtype))
+    y = jax.nn.relu(_group_norm(y, **params["gn1"]))
+    y = _conv(y, params["conv2"].astype(x.dtype), stride)
+    y = jax.nn.relu(_group_norm(y, **params["gn2"]))
+    y = _conv(y, params["conv3"].astype(x.dtype))
+    y = _group_norm(y, **params["gn3"])
+    if "proj" in params:
+        x = _conv(x, params["proj"].astype(x.dtype), stride)
+        x = _group_norm(x, **params["gn_proj"])
+    return jax.nn.relu(x + y)
+
+
+def _init_params(rng, stages: Tuple[int, ...], width: int) -> Dict[str, Any]:
+    ks = jax.random.split(rng, 2 + len(stages))
+    params: Dict[str, Any] = {
+        "stem": {
+            "conv": _conv_init(ks[0], (3, 3, 3, width)),
+            "gn": {"scale": jnp.ones((width,)), "bias": jnp.zeros((width,))},
+        },
+        "stages": {},
+    }
+    in_ch = width
+    for s, n_blocks in enumerate(stages):
+        mid = width * (2**s)
+        stage = {}
+        block_keys = jax.random.split(ks[1 + s], n_blocks)
+        for b in range(n_blocks):
+            stride = 2 if (s > 0 and b == 0) else 1
+            stage[f"block{b}"] = _init_block(block_keys[b], in_ch, mid, stride)
+            in_ch = mid * 4
+        params["stages"][f"stage{s}"] = stage
+    params["head"] = {
+        "w": jax.nn.initializers.glorot_normal()(
+            ks[-1], (in_ch, NUM_CLASSES), jnp.float32
+        ),
+        "b": jnp.zeros((NUM_CLASSES,), jnp.float32),
+    }
+    return params
+
+
+def _apply(
+    params,
+    batch,
+    train: bool = False,
+    stages: Tuple[int, ...] = (),
+    compute_dtype=jnp.bfloat16,
+    **_,
+):
+    x = batch["images"].astype(compute_dtype)
+    stem = params["stem"]
+    x = _conv(x, stem["conv"].astype(compute_dtype))
+    x = jax.nn.relu(_group_norm(x, **stem["gn"]))
+    for s, n_blocks in enumerate(stages):
+        for b in range(n_blocks):
+            stride = 2 if (s > 0 and b == 0) else 1
+            x = _apply_block(params["stages"][f"stage{s}"][f"block{b}"], x, stride)
+    x = jnp.mean(x, axis=(1, 2), dtype=jnp.float32)
+    head = params["head"]
+    return x @ head["w"] + head["b"]
+
+
+def _loss(logits, batch):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, batch["labels"]
+    ).mean()
+
+
+def _metrics(logits, batch):
+    return {
+        "accuracy": (jnp.argmax(logits, -1) == batch["labels"]).mean(),
+        "loss": _loss(logits, batch),
+    }
+
+
+def _example_batch(batch_size: int):
+    return {
+        "images": jnp.zeros((batch_size,) + IMAGE_SHAPE, jnp.float32),
+        "labels": jnp.zeros((batch_size,), jnp.int32),
+    }
+
+
+def model_spec(
+    learning_rate: float = 0.1,
+    compute_dtype: str = "bfloat16",
+    depth: int = 50,
+    width: int = 64,
+) -> ModelSpec:
+    """depth=50 -> bottleneck stages (3,4,6,3); depth=14 (tests) -> (1,1,1,1)."""
+    stage_map = {50: (3, 4, 6, 3), 26: (2, 2, 2, 2), 14: (1, 1, 1, 1)}
+    if depth not in stage_map:
+        raise ValueError(f"unsupported depth {depth}, pick from {sorted(stage_map)}")
+    stages = stage_map[depth]
+    dtype = jnp.dtype(compute_dtype)
+    return ModelSpec(
+        name=f"cifar10_resnet{depth}",
+        init=functools.partial(_init_params, stages=stages, width=width),
+        apply=functools.partial(_apply, stages=stages, compute_dtype=dtype),
+        loss=_loss,
+        metrics=_metrics,
+        optimizer=optax.sgd(learning_rate, momentum=0.9, nesterov=True),
+        example_batch=_example_batch,
+    )

@@ -1,0 +1,161 @@
+"""Wide&Deep on Census-income — BASELINE.json config #3 ("Wide&Deep on Census
+income, ParameterServer mode + elasticdl.layers.Embedding").
+
+Reference parity [D: config list; sources unverifiable — mount empty at survey
+time]: the reference's census model feeds ``elasticdl_preprocessing`` hashing/
+lookup layers into PS-hosted embeddings.  Here both the wide table (linear
+weights over hashed singles + pairwise crosses) and the deep table are fused,
+mesh-sharded embedding tables; hashing and crossing run on-device inside the
+jitted step (models/tabular.py).
+
+Census schema (classic UCI adult): 5 numeric (age, education_num,
+capital_gain, capital_loss, hours_per_week) + 9 categorical (workclass,
+education, marital_status, occupation, relationship, race, sex,
+native_country, income-bracket source field unused).
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from elasticdl_tpu.models.spec import EmbeddingTableSpec, ModelSpec
+from elasticdl_tpu.models.tabular import (
+    bce_loss,
+    binary_metrics,
+    fuse_feature_ids,
+    hash_buckets,
+    log_normalize,
+)
+from elasticdl_tpu.ops.embedding import ParallelContext, embedding_lookup, pad_vocab
+
+NUM_DENSE = 5
+NUM_CAT = 9
+_CROSSES = tuple(itertools.combinations(range(NUM_CAT), 2))  # all 36 pairs
+
+
+def _wide_ids(cat: jax.Array, buckets: int) -> jax.Array:
+    """[b, NUM_CAT + len(_CROSSES)] fused wide-table ids: hashed singles then
+    hashed pairwise crosses, each slot with its own row range."""
+    singles = fuse_feature_ids(cat, buckets)  # [b, 9]
+    a = cat[:, [i for i, _ in _CROSSES]].astype(jnp.uint32)
+    b = cat[:, [j for _, j in _CROSSES]].astype(jnp.uint32)
+    crossed = hash_buckets(a * jnp.uint32(1000003) + b, buckets)
+    offsets = (NUM_CAT + jnp.arange(len(_CROSSES), dtype=jnp.int32)) * buckets
+    return jnp.concatenate([singles, crossed + offsets], axis=-1)
+
+
+def _init_params(rng, buckets: int, embedding_dim: int, hidden: tuple):
+    wide_vocab = pad_vocab((NUM_CAT + len(_CROSSES)) * buckets)
+    deep_vocab = pad_vocab(NUM_CAT * buckets)
+    ks = jax.random.split(rng, 3 + len(hidden))
+    glorot = jax.nn.initializers.glorot_normal()
+    params: Dict[str, Any] = {
+        "wide": jnp.zeros((wide_vocab, 1), jnp.float32),
+        "deep_embedding": jax.random.normal(ks[0], (deep_vocab, embedding_dim)) * 0.05,
+        "mlp": {},
+        "bias": jnp.zeros((1,), jnp.float32),
+    }
+    in_dim = NUM_CAT * embedding_dim + NUM_DENSE
+    for i, width in enumerate(hidden):
+        params["mlp"][f"layer{i}"] = {
+            "w": glorot(ks[1 + i], (in_dim, width), jnp.float32),
+            "b": jnp.zeros((width,), jnp.float32),
+        }
+        in_dim = width
+    params["mlp"]["out"] = {
+        "w": glorot(ks[1 + len(hidden)], (in_dim, 1), jnp.float32),
+        "b": jnp.zeros((1,), jnp.float32),
+    }
+    return params
+
+
+def _apply(
+    params,
+    batch,
+    train: bool = False,
+    ctx: ParallelContext = ParallelContext(),
+    buckets: int = 0,
+    compute_dtype=jnp.bfloat16,
+    **_,
+):
+    cat = batch["cat"]
+    dense = log_normalize(batch["dense"])
+
+    wide_ids = _wide_ids(cat, buckets)
+    deep_ids = fuse_feature_ids(cat, buckets)
+
+    wide_w = embedding_lookup(params["wide"], wide_ids, ctx)  # [b, nw, 1]
+    emb = embedding_lookup(params["deep_embedding"], deep_ids, ctx)  # [b, 9, d]
+
+    wide = jnp.sum(wide_w[..., 0], axis=-1, dtype=jnp.float32)
+
+    x = jnp.concatenate(
+        [emb.reshape(emb.shape[0], -1), dense], axis=-1
+    ).astype(compute_dtype)
+    mlp = params["mlp"]
+    for i in range(len(mlp) - 1):
+        layer = jax.tree.map(lambda a: a.astype(compute_dtype), mlp[f"layer{i}"])
+        x = jax.nn.relu(x @ layer["w"] + layer["b"])
+    out = jax.tree.map(lambda a: a.astype(compute_dtype), mlp["out"])
+    deep = (x @ out["w"] + out["b"])[:, 0].astype(jnp.float32)
+
+    return wide + deep + params["bias"][0]
+
+
+def _loss(logits, batch):
+    return bce_loss(logits, batch["labels"])
+
+
+def _metrics(logits, batch):
+    return binary_metrics(logits, batch["labels"])
+
+
+def _example_batch(batch_size: int):
+    return {
+        "dense": jnp.zeros((batch_size, NUM_DENSE), jnp.float32),
+        "cat": jnp.zeros((batch_size, NUM_CAT), jnp.int32),
+        "labels": jnp.zeros((batch_size,), jnp.int32),
+    }
+
+
+def model_spec(
+    learning_rate: float = 1e-3,
+    compute_dtype: str = "bfloat16",
+    buckets: int = 1024,
+    embedding_dim: int = 8,
+    hidden: Any = (100, 50),
+) -> ModelSpec:
+    if isinstance(hidden, (list, tuple)):
+        hidden = tuple(int(h) for h in hidden)
+    else:
+        hidden = tuple(int(h) for h in str(hidden).split(",") if h)
+    dtype = jnp.dtype(compute_dtype)
+    return ModelSpec(
+        name="wide_deep",
+        init=functools.partial(
+            _init_params, buckets=buckets, embedding_dim=embedding_dim, hidden=hidden
+        ),
+        apply=functools.partial(_apply, buckets=buckets, compute_dtype=dtype),
+        loss=_loss,
+        metrics=_metrics,
+        optimizer=optax.adam(learning_rate),
+        embedding_tables=[
+            EmbeddingTableSpec(
+                path=("wide",),
+                vocab_size=(NUM_CAT + len(_CROSSES)) * buckets,
+                dim=1,
+            ),
+            EmbeddingTableSpec(
+                path=("deep_embedding",),
+                vocab_size=NUM_CAT * buckets,
+                dim=embedding_dim,
+            ),
+        ],
+        example_batch=_example_batch,
+    )
