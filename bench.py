@@ -1,0 +1,95 @@
+"""Headline benchmark: DeepFM/Criteo training throughput, examples/sec/chip
+(BASELINE.json metric).
+
+Runs the full hybrid train step (mesh-sharded embedding tables + psum'd dense
+grads) on all available devices with synthetic Criteo-shaped data, measures
+steady-state steps/sec, prints ONE JSON line.
+
+``vs_baseline``: no published reference number exists (BASELINE.json
+``"published": {}``; see BASELINE.md).  The denominator below is a documented
+ESTIMATE of per-V100 ElasticDL DeepFM throughput implied by the north-star
+target ("match 8xV100 Horovod throughput"): ~120k examples/sec/GPU for a
+small DeepFM with PS-hosted embeddings.  Treat vs_baseline as relative to
+that stand-in until a real number is obtainable.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from elasticdl_tpu.common.config import DistributionStrategy, JobConfig
+from elasticdl_tpu.models.spec import load_model_spec
+from elasticdl_tpu.parallel.mesh import create_mesh
+from elasticdl_tpu.parallel.trainer import Trainer
+
+# Stand-in for the unpublished reference number (see module docstring).
+REFERENCE_EXAMPLES_PER_SEC_PER_CHIP = 120_000.0
+
+GLOBAL_BATCH = 8192
+WARMUP_STEPS = 5
+MEASURE_STEPS = 30
+
+
+def _batch(n: int):
+    # Synthetic Criteo-shaped batch; ids spread across the full hashed space.
+    k = jax.random.key(7)
+    k1, k2, k3 = jax.random.split(k, 3)
+    return {
+        "dense": jax.random.uniform(k1, (n, 13), jnp.float32, 0.0, 1000.0),
+        "cat": jax.random.randint(k2, (n, 26), 0, 1 << 30),
+        "labels": jax.random.bernoulli(k3, 0.25, (n,)).astype(jnp.int32),
+    }
+
+
+def main() -> None:
+    devices = jax.devices()
+    n = len(devices)
+    batch_size = max(GLOBAL_BATCH // n * n, n)
+
+    spec = load_model_spec(
+        "elasticdl_tpu.models",
+        "deepfm.model_spec",
+        buckets_per_feature=65536,
+        embedding_dim=8,
+        hidden=(400, 400),
+    )
+    mesh = create_mesh(devices)
+    trainer = Trainer(
+        spec,
+        JobConfig(distribution_strategy=DistributionStrategy.PARAMETER_SERVER),
+        mesh,
+    )
+    state = trainer.init_state(jax.random.key(0))
+    batch = trainer.shard_batch(_batch(batch_size))
+
+    for _ in range(WARMUP_STEPS):
+        state, metrics = trainer.train_step(state, batch)
+    jax.block_until_ready(metrics)
+
+    t0 = time.perf_counter()
+    for _ in range(MEASURE_STEPS):
+        state, metrics = trainer.train_step(state, batch)
+    jax.block_until_ready(metrics)
+    elapsed = time.perf_counter() - t0
+
+    eps_per_chip = batch_size * MEASURE_STEPS / elapsed / n
+    print(
+        json.dumps(
+            {
+                "metric": "deepfm_criteo_examples_per_sec_per_chip",
+                "value": round(eps_per_chip, 1),
+                "unit": "examples/sec/chip",
+                "vs_baseline": round(
+                    eps_per_chip / REFERENCE_EXAMPLES_PER_SEC_PER_CHIP, 3
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
