@@ -1,0 +1,22 @@
+"""Data layer: shardable readers + the record->batch feed path.
+
+Reference parity (SURVEY.md §2 #14, upstream layout [U — mount empty at
+survey time]): ``AbstractDataReader`` with ``create_shards()`` /
+``read_records(task)``, implemented for RecordIO files, ODPS tables and CSV
+text.  Here: a recordio-style length-prefixed binary format, CSV/text lines,
+and synthetic generators (ODPS is cloud-SDK-gated in the reference and out of
+an offline TPU image's scope — the reader ABC is the extension point).
+
+Records cross the reader as ``bytes``; each model-zoo module exports a
+``feed`` that vectorizes records into device-ready arrays (the reference's
+``feed``/``dataset_fn`` role).
+"""
+
+from elasticdl_tpu.data.reader import (  # noqa: F401
+    AbstractDataReader,
+    CSVDataReader,
+    RecordIODataReader,
+    Shard,
+    create_data_reader,
+)
+from elasticdl_tpu.data.recordio import RecordIOReader, RecordIOWriter  # noqa: F401

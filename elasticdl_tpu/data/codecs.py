@@ -1,0 +1,105 @@
+"""Record codecs: serialized example <-> device-ready numpy batches.
+
+The encode side is used by the synthetic-data generators and tests; the feed
+(decode) side is each model's ``ModelSpec.feed`` (the reference's
+``feed``/``dataset_fn`` role).  Formats mirror the real datasets' canonical
+shapes so a user can point the readers at actual MNIST/Criteo/Census dumps:
+
+- mnist/cifar10: raw little-endian bytes, image uint8s then one label byte
+  (recordio payloads).
+- criteo: the Kaggle TSV — ``label\\t13 ints\\t26 hex cat ids`` with blanks
+  allowed (missing values).
+- census: CSV — ``label,5 numerics,9 categorical strings``.
+
+String categoricals are mapped to stable int ids with crc32 on the host; the
+model re-buckets them on device (models/tabular.py), matching the reference's
+Hashing-preprocessing-then-Embedding pipeline.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Sequence
+
+import numpy as np
+
+# ---------------- image families ----------------
+
+
+def encode_image_example(image: np.ndarray, label: int) -> bytes:
+    return np.ascontiguousarray(image, dtype=np.uint8).tobytes() + bytes([label])
+
+
+def _image_feed(records: Sequence[bytes], shape) -> dict:
+    n = int(np.prod(shape))
+    buf = np.frombuffer(b"".join(records), dtype=np.uint8).reshape(-1, n + 1)
+    images = buf[:, :n].reshape((-1,) + shape).astype(np.float32) / 255.0
+    labels = buf[:, n].astype(np.int32)
+    return {"images": images, "labels": labels}
+
+
+def mnist_feed(records: Sequence[bytes]) -> dict:
+    return _image_feed(records, (28, 28, 1))
+
+
+def cifar10_feed(records: Sequence[bytes]) -> dict:
+    return _image_feed(records, (32, 32, 3))
+
+
+# ---------------- criteo (deepfm) ----------------
+
+_CRITEO_DENSE = 13
+_CRITEO_CAT = 26
+
+
+def encode_criteo_example(
+    label: int, dense: Sequence[float], cats: Sequence[int]
+) -> bytes:
+    fields = [str(label)]
+    fields += ["" if d is None else str(int(d)) for d in dense]
+    fields += ["%08x" % (c & 0xFFFFFFFF) for c in cats]
+    return "\t".join(fields).encode()
+
+
+def criteo_feed(records: Sequence[bytes]) -> dict:
+    n = len(records)
+    dense = np.zeros((n, _CRITEO_DENSE), np.float32)
+    cat = np.zeros((n, _CRITEO_CAT), np.int32)
+    labels = np.zeros((n,), np.int32)
+    for i, rec in enumerate(records):
+        parts = rec.decode().split("\t")
+        labels[i] = int(parts[0])
+        for j, v in enumerate(parts[1 : 1 + _CRITEO_DENSE]):
+            dense[i, j] = float(v) if v else 0.0
+        for j, v in enumerate(parts[1 + _CRITEO_DENSE :]):
+            cat[i, j] = np.int32(np.uint32(int(v, 16))) if v else 0
+    return {"dense": dense, "cat": cat, "labels": labels}
+
+
+# ---------------- census (wide&deep) ----------------
+
+_CENSUS_DENSE = 5
+_CENSUS_CAT = 9
+
+
+def encode_census_example(
+    label: int, dense: Sequence[float], cats: Sequence[str]
+) -> bytes:
+    fields = [str(label)] + [str(float(d)) for d in dense] + list(cats)
+    return ",".join(fields).encode()
+
+
+def census_feed(records: Sequence[bytes]) -> dict:
+    n = len(records)
+    dense = np.zeros((n, _CENSUS_DENSE), np.float32)
+    cat = np.zeros((n, _CENSUS_CAT), np.int32)
+    labels = np.zeros((n,), np.int32)
+    for i, rec in enumerate(records):
+        parts = rec.decode().split(",")
+        labels[i] = int(parts[0])
+        dense[i] = [float(v) if v else 0.0 for v in parts[1 : 1 + _CENSUS_DENSE]]
+        cat[i] = [
+            np.int32(zlib.crc32(v.strip().encode()) & 0x7FFFFFFF)
+            for v in parts[1 + _CENSUS_DENSE :]
+        ]
+    return {"dense": dense, "cat": cat, "labels": labels}

@@ -1,0 +1,139 @@
+"""Shardable data readers.
+
+Reference parity (SURVEY.md §2 #14 [U — mount empty at survey time]): the
+master calls ``create_shards()`` to enumerate (name, start, end) ranges that
+become dispatchable tasks; workers call ``read_records(shard)`` for the range
+a task names.  Epoch/task logic lives in the master's TaskDispatcher, NOT
+here — readers are stateless range servers, which is what makes a preempted
+worker's work requeue-able with no data loss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import os
+from typing import Dict, Iterator, List, Optional
+
+from elasticdl_tpu.data.recordio import RecordIOReader
+
+
+@dataclasses.dataclass(frozen=True)
+class Shard:
+    """A half-open record range [start, end) within a named source."""
+
+    name: str
+    start: int
+    end: int
+
+    @property
+    def size(self) -> int:
+        return self.end - self.start
+
+
+class AbstractDataReader:
+    """Stateless, range-addressable record source."""
+
+    def create_shards(self, records_per_shard: int) -> List[Shard]:
+        raise NotImplementedError
+
+    def read_records(self, shard: Shard) -> Iterator[bytes]:
+        raise NotImplementedError
+
+
+def _expand(path_spec: str) -> List[str]:
+    """A data path may be a file, a directory, or a glob."""
+    if os.path.isdir(path_spec):
+        files = sorted(
+            os.path.join(path_spec, f) for f in os.listdir(path_spec)
+        )
+    else:
+        files = sorted(glob.glob(path_spec)) or [path_spec]
+    missing = [f for f in files if not os.path.isfile(f)]
+    if missing:
+        raise FileNotFoundError(f"data files not found: {missing}")
+    return files
+
+
+def _range_shards(sizes: Dict[str, int], records_per_shard: int) -> List[Shard]:
+    shards = []
+    for name, total in sizes.items():
+        for start in range(0, total, records_per_shard):
+            shards.append(Shard(name, start, min(start + records_per_shard, total)))
+    return shards
+
+
+class RecordIODataReader(AbstractDataReader):
+    def __init__(self, data_path: str, **_):
+        self._readers = {p: RecordIOReader(p) for p in _expand(data_path)}
+
+    def create_shards(self, records_per_shard: int) -> List[Shard]:
+        sizes = {p: len(r) for p, r in self._readers.items()}
+        return _range_shards(sizes, records_per_shard)
+
+    def read_records(self, shard: Shard) -> Iterator[bytes]:
+        return self._readers[shard.name].read_range(shard.start, shard.end)
+
+
+class CSVDataReader(AbstractDataReader):
+    """Text files, one record per line; ranges address line numbers.
+
+    ``skip_header=True`` drops the first line of each file.  Line offsets are
+    indexed once per file (same trade as the recordio scan).
+    """
+
+    def __init__(self, data_path: str, skip_header: bool = False, **_):
+        self._files = _expand(data_path)
+        self._skip = 1 if skip_header else 0
+        self._index: Dict[str, List[int]] = {}
+
+    def _offsets(self, path: str) -> List[int]:
+        if path not in self._index:
+            offsets = []
+            with open(path, "rb") as f:
+                pos = f.tell()
+                for line in f:
+                    offsets.append(pos)
+                    pos += len(line)
+            self._index[path] = offsets[self._skip :]
+        return self._index[path]
+
+    def create_shards(self, records_per_shard: int) -> List[Shard]:
+        sizes = {p: len(self._offsets(p)) for p in self._files}
+        return _range_shards(sizes, records_per_shard)
+
+    def read_records(self, shard: Shard) -> Iterator[bytes]:
+        offsets = self._offsets(shard.name)
+        with open(shard.name, "rb") as f:
+            f.seek(offsets[shard.start])
+            for _ in range(shard.end - shard.start):
+                yield f.readline().rstrip(b"\r\n")
+
+
+_READERS = {
+    "recordio": RecordIODataReader,
+    "csv": CSVDataReader,
+    "text": CSVDataReader,
+}
+
+
+def create_data_reader(
+    data_path: str, reader_params: Optional[dict] = None
+) -> AbstractDataReader:
+    """Build a reader for ``data_path``.
+
+    ``reader_params`` (the config's ``--data_reader_params``) may carry
+    ``format=recordio|csv`` plus reader kwargs; default is sniffed from the
+    first file's magic bytes.
+    """
+    params = dict(reader_params or {})
+    fmt = params.pop("format", None)
+    if fmt is None:
+        first = _expand(data_path)[0]
+        with open(first, "rb") as f:
+            from elasticdl_tpu.data.recordio import MAGIC
+
+            fmt = "recordio" if f.read(len(MAGIC)) == MAGIC else "csv"
+    if fmt not in _READERS:
+        raise ValueError(f"unknown data format {fmt!r}, pick from {sorted(_READERS)}")
+    return _READERS[fmt](data_path, **params)

@@ -1,0 +1,98 @@
+"""A minimal recordio-style container: the reference stores training data in
+RecordIO files whose numbered records make range-sharding natural (SURVEY.md
+§2 #14 [U]).  Format, per record:
+
+    [uint32 payload_len][uint32 crc32(payload)][payload bytes]
+
+little-endian, no compression.  Files carry a 8-byte magic header.  A sidecar
+index is NOT required: ``RecordIOReader.index()`` scans once and caches record
+offsets, so shard handout (record ranges) and ranged reads are O(1) after the
+first scan.  A C++ scanner for the hot ingest path lives in
+``elasticdl_tpu/ps/native`` (built lazily; this module is the pure-Python
+fallback and the format's source of truth).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from typing import Iterator, List, Optional, Sequence
+
+MAGIC = b"EDLRIO\x00\x01"
+_HDR = struct.Struct("<II")
+
+
+class RecordIOWriter:
+    def __init__(self, path: str):
+        self._f = open(path, "wb")
+        self._f.write(MAGIC)
+        self._count = 0
+
+    def write(self, payload: bytes) -> None:
+        self._f.write(_HDR.pack(len(payload), zlib.crc32(payload)))
+        self._f.write(payload)
+        self._count += 1
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.close()
+
+    def __enter__(self) -> "RecordIOWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+
+class RecordIOReader:
+    def __init__(self, path: str):
+        self.path = path
+        self._offsets: Optional[List[int]] = None
+        with open(path, "rb") as f:
+            if f.read(len(MAGIC)) != MAGIC:
+                raise ValueError(f"{path}: not a recordio file")
+
+    def index(self) -> List[int]:
+        """Byte offset of each record (cached one-time scan)."""
+        if self._offsets is None:
+            offsets = []
+            size = os.path.getsize(self.path)
+            with open(self.path, "rb") as f:
+                pos = len(MAGIC)
+                while pos < size:
+                    offsets.append(pos)
+                    f.seek(pos)
+                    length, _ = _HDR.unpack(f.read(_HDR.size))
+                    pos += _HDR.size + length
+            self._offsets = offsets
+        return self._offsets
+
+    def __len__(self) -> int:
+        return len(self.index())
+
+    def read_range(self, start: int, end: int) -> Iterator[bytes]:
+        """Yield records [start, end) by record index, CRC-checked."""
+        offsets = self.index()
+        end = min(end, len(offsets))
+        if start >= end:
+            return
+        with open(self.path, "rb") as f:
+            f.seek(offsets[start])
+            for _ in range(end - start):
+                length, crc = _HDR.unpack(f.read(_HDR.size))
+                payload = f.read(length)
+                if zlib.crc32(payload) != crc:
+                    raise IOError(f"{self.path}: CRC mismatch")
+                yield payload
+
+
+def write_records(path: str, records: Sequence[bytes]) -> int:
+    with RecordIOWriter(path) as w:
+        for r in records:
+            w.write(r)
+        return w.count

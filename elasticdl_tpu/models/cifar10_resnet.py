@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from elasticdl_tpu.data.codecs import cifar10_feed
 from elasticdl_tpu.models.spec import ModelSpec
 
 NUM_CLASSES = 10
@@ -172,5 +173,6 @@ def model_spec(
         loss=_loss,
         metrics=_metrics,
         optimizer=optax.sgd(learning_rate, momentum=0.9, nesterov=True),
+        feed=cifar10_feed,
         example_batch=_example_batch,
     )

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from elasticdl_tpu.data.codecs import criteo_feed
 from elasticdl_tpu.models.spec import EmbeddingTableSpec, ModelSpec
 from elasticdl_tpu.models.tabular import (
     bce_loss,
@@ -161,5 +162,6 @@ def model_spec(
             EmbeddingTableSpec(path=("fm_embedding",), vocab_size=vocab, dim=dim),
             EmbeddingTableSpec(path=("fm_linear",), vocab_size=vocab, dim=1),
         ],
+        feed=criteo_feed,
         example_batch=_example_batch,
     )

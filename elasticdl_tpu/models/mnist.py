@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from elasticdl_tpu.data.codecs import mnist_feed
 from elasticdl_tpu.models.spec import ModelSpec
 
 IMAGE_SHAPE = (28, 28, 1)
@@ -98,5 +99,6 @@ def model_spec(learning_rate: float = 1e-3, compute_dtype: str = "bfloat16") -> 
         loss=_loss,
         metrics=_metrics,
         optimizer=optax.sgd(learning_rate, momentum=0.9),
+        feed=mnist_feed,
         example_batch=_example_batch,
     )

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from elasticdl_tpu.data.codecs import census_feed
 from elasticdl_tpu.models.spec import EmbeddingTableSpec, ModelSpec
 from elasticdl_tpu.models.tabular import (
     bce_loss,
@@ -157,5 +158,6 @@ def model_spec(
                 dim=embedding_dim,
             ),
         ],
+        feed=census_feed,
         example_batch=_example_batch,
     )
