@@ -1,0 +1,64 @@
+"""JSON-over-gRPC plumbing.
+
+The reference defines its master/PS contract in protobuf (SURVEY.md §2 #12
+[U]).  This image ships ``grpcio`` but not ``grpc_tools`` (no protoc python
+plugin), so the rebuild keeps gRPC as the wire protocol — HTTP/2, the same
+operational surface — with JSON message bodies registered through generic
+method handlers instead of generated stubs.  The method table in
+``master/servicer.py`` is the contract.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict
+
+import grpc
+
+SERVICE_NAME = "elasticdl.Master"
+
+
+def _serialize(msg: Dict[str, Any]) -> bytes:
+    return json.dumps(msg).encode()
+
+
+def _deserialize(payload: bytes) -> Dict[str, Any]:
+    return json.loads(payload.decode()) if payload else {}
+
+
+def make_generic_handler(
+    service_name: str, methods: Dict[str, Callable[[dict], dict]]
+) -> grpc.GenericRpcHandler:
+    handlers = {
+        name: grpc.unary_unary_rpc_method_handler(
+            lambda req, ctx, fn=fn: fn(req),
+            request_deserializer=_deserialize,
+            response_serializer=_serialize,
+        )
+        for name, fn in methods.items()
+    }
+    return grpc.method_handlers_generic_handler(service_name, handlers)
+
+
+class JsonRpcClient:
+    """Typed-enough client for a JSON-over-gRPC service."""
+
+    def __init__(self, address: str, service_name: str = SERVICE_NAME):
+        self._channel = grpc.insecure_channel(address)
+        self._service = service_name
+        self._stubs: Dict[str, Callable] = {}
+
+    def wait_ready(self, timeout_s: float = 10.0) -> None:
+        grpc.channel_ready_future(self._channel).result(timeout=timeout_s)
+
+    def call(self, method: str, request: Dict[str, Any], timeout_s: float = 30.0):
+        if method not in self._stubs:
+            self._stubs[method] = self._channel.unary_unary(
+                f"/{self._service}/{method}",
+                request_serializer=_serialize,
+                response_deserializer=_deserialize,
+            )
+        return self._stubs[method](request, timeout=timeout_s)
+
+    def close(self) -> None:
+        self._channel.close()

@@ -1,0 +1,104 @@
+"""Elastic membership: the versioned worker list.
+
+Reference parity (SURVEY.md §2 #5 [D: RendezvousServer named in BASELINE
+north_star]): the reference's master hosts a rendezvous server from which
+elastic Horovod re-initializes its NCCL/Gloo communicator after membership
+changes.  TPU rebuild: the version bump is the signal for workers to rebuild
+the ``jax.sharding.Mesh`` (parallel/mesh.MeshManager.reform) and re-place
+state from the latest checkpoint — see worker/main loop and SURVEY.md §3.5.
+
+Ranks are assigned deterministically (sorted worker ids) so every worker
+derives the same mesh layout from the same membership version without extra
+coordination.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+
+class RendezvousServer:
+    def __init__(
+        self,
+        heartbeat_timeout_s: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self._lock = threading.Lock()
+        self._workers: Dict[str, float] = {}  # worker_id -> last heartbeat
+        self._version = 0
+        self._timeout = heartbeat_timeout_s
+        self._clock = clock
+        self._listeners: List[Callable[[int, List[str]], None]] = []
+
+    def add_listener(self, fn: Callable[[int, List[str]], None]) -> None:
+        """fn(version, sorted_worker_ids) fires on every membership change."""
+        self._listeners.append(fn)
+
+    def _notify(self, version: int, members: List[str]) -> None:
+        for fn in self._listeners:
+            fn(version, members)
+
+    def register(self, worker_id: str) -> int:
+        """Worker joins (or re-joins). Returns the new membership version."""
+        with self._lock:
+            is_new = worker_id not in self._workers
+            self._workers[worker_id] = self._clock()
+            if is_new:
+                self._version += 1
+                members = sorted(self._workers)
+                version = self._version
+            else:
+                return self._version
+        self._notify(version, members)
+        return version
+
+    def remove(self, worker_id: str) -> int:
+        with self._lock:
+            if worker_id not in self._workers:
+                return self._version
+            del self._workers[worker_id]
+            self._version += 1
+            version, members = self._version, sorted(self._workers)
+        self._notify(version, members)
+        return version
+
+    def heartbeat(self, worker_id: str) -> int:
+        """Refresh liveness; re-registers a worker the reaper evicted."""
+        with self._lock:
+            if worker_id in self._workers:
+                self._workers[worker_id] = self._clock()
+                return self._version
+        return self.register(worker_id)
+
+    def reap_dead(self) -> List[str]:
+        """Evict workers whose heartbeat is stale. Returns the evicted ids."""
+        with self._lock:
+            now = self._clock()
+            dead = [
+                w for w, t in self._workers.items() if now - t > self._timeout
+            ]
+            if not dead:
+                return []
+            for w in dead:
+                del self._workers[w]
+            self._version += 1
+            version, members = self._version, sorted(self._workers)
+        self._notify(version, members)
+        return dead
+
+    def membership(self) -> dict:
+        """The worker-visible view: version + deterministic rank assignment."""
+        with self._lock:
+            members = sorted(self._workers)
+            return {
+                "version": self._version,
+                "workers": members,
+                "ranks": {w: i for i, w in enumerate(members)},
+                "world_size": len(members),
+            }
+
+    def version(self) -> int:
+        with self._lock:
+            return self._version

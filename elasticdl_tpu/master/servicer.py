@@ -1,0 +1,195 @@
+"""The master RPC service: task hand-out, result/metric reports, rendezvous.
+
+Reference parity (SURVEY.md §2 #2, §3.2 [U]; RPC names follow the upstream
+Master service — GetTask / ReportTaskResult / ReportVersion — plus the
+rendezvous and checkpoint surface the north star requires).  Handlers are
+plain methods taking/returning dicts, so unit tests call them directly with
+no network (the reference's decisive test pattern, SURVEY.md §4); ``serve()``
+exposes the same handlers over gRPC for real deployments.
+
+Method table (the wire contract):
+
+  GetTask            {worker_id}                       -> {task?, finished}
+  ReportTaskResult   {worker_id, task_id, success,
+                      metrics?, weight?, model_version?} -> {accepted}
+  ReportVersion      {worker_id, model_version}        -> {}
+  RegisterWorker     {worker_id}                       -> membership
+  Heartbeat          {worker_id}                       -> {version}
+  GetMembership      {}                                -> membership
+  GetCheckpoint      {}                                -> {path?, step}
+  ReportCheckpoint   {path, step}                      -> {}
+  JobStatus          {}                                -> counts + metrics
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent import futures
+from typing import Dict, Optional
+
+import grpc
+
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.common.rpc import SERVICE_NAME, make_generic_handler
+from elasticdl_tpu.master.evaluation_service import EvaluationService
+from elasticdl_tpu.master.rendezvous import RendezvousServer
+from elasticdl_tpu.master.task_dispatcher import (
+    TASK_EVALUATION,
+    TaskDispatcher,
+)
+
+logger = get_logger("master.servicer")
+
+
+class MasterServicer:
+    def __init__(
+        self,
+        dispatcher: TaskDispatcher,
+        rendezvous: Optional[RendezvousServer] = None,
+        evaluation: Optional[EvaluationService] = None,
+    ):
+        self.dispatcher = dispatcher
+        self.rendezvous = rendezvous or RendezvousServer()
+        self.evaluation = evaluation
+        self._lock = threading.Lock()
+        self._model_version = 0
+        self._checkpoint: Dict[str, object] = {"path": None, "step": 0}
+        # A dead worker's tasks must be requeued in BOTH dispatchers.
+        self.rendezvous.add_listener(self._on_membership_change)
+        self._known_workers: set = set()
+
+    # -- rendezvous listener: requeue tasks of evicted workers --
+
+    def _on_membership_change(self, version: int, members) -> None:
+        gone = self._known_workers - set(members)
+        for worker_id in gone:
+            lost = self.dispatcher.recover_tasks(worker_id)
+            lost_eval = (
+                self.evaluation.recover_tasks(worker_id) if self.evaluation else []
+            )
+            if lost or lost_eval:
+                logger.info(
+                    "requeued %d train + %d eval tasks of %s",
+                    len(lost), len(lost_eval), worker_id,
+                )
+        self._known_workers = set(members)
+
+    # -- handlers (dict in, dict out) --
+
+    def GetTask(self, req: dict) -> dict:
+        worker_id = req["worker_id"]
+        # Eval rounds preempt training tasks so metrics snapshot a consistent
+        # model version quickly (reference behavior: eval tasks share the queue
+        # with priority).
+        if self.evaluation is not None:
+            task = self.evaluation.get_task(worker_id)
+            if task is not None:
+                return {"task": task.to_dict(), "finished": False}
+        task = self.dispatcher.get_task(worker_id)
+        if task is None:
+            return {"task": None, "finished": self.dispatcher.finished()}
+        return {"task": task.to_dict(), "finished": False}
+
+    def ReportTaskResult(self, req: dict) -> dict:
+        task_id = int(req["task_id"])
+        success = bool(req.get("success", True))
+        task_type = req.get("task_type", "")
+        if task_type == TASK_EVALUATION and self.evaluation is not None:
+            # Metrics BEFORE report_task: completing the round's last task
+            # snapshots the aggregate.
+            if success and req.get("metrics"):
+                self.evaluation.report_metrics(
+                    {k: float(v) for k, v in req["metrics"].items()},
+                    float(req.get("weight", 1.0)),
+                )
+            accepted = self.evaluation.report_task(task_id, success)
+        else:
+            accepted = self.dispatcher.report(
+                task_id, success, req.get("worker_id", "")
+            )
+        if "model_version" in req:
+            self._bump_version(int(req["model_version"]))
+        return {"accepted": accepted}
+
+    def ReportVersion(self, req: dict) -> dict:
+        self._bump_version(int(req["model_version"]))
+        return {}
+
+    def _bump_version(self, version: int) -> None:
+        with self._lock:
+            self._model_version = max(self._model_version, version)
+            current = self._model_version
+        if self.evaluation is not None:
+            self.evaluation.maybe_trigger(current)
+
+    def RegisterWorker(self, req: dict) -> dict:
+        self.rendezvous.register(req["worker_id"])
+        self._known_workers.add(req["worker_id"])
+        return self.rendezvous.membership()
+
+    def Heartbeat(self, req: dict) -> dict:
+        return {"version": self.rendezvous.heartbeat(req["worker_id"])}
+
+    def GetMembership(self, req: dict) -> dict:
+        return self.rendezvous.membership()
+
+    def GetCheckpoint(self, req: dict) -> dict:
+        with self._lock:
+            return dict(self._checkpoint)
+
+    def ReportCheckpoint(self, req: dict) -> dict:
+        with self._lock:
+            if int(req["step"]) >= int(self._checkpoint["step"] or 0):
+                self._checkpoint = {"path": req["path"], "step": int(req["step"])}
+        return {}
+
+    def JobStatus(self, req: dict) -> dict:
+        status = self.dispatcher.counts()
+        with self._lock:
+            status["model_version"] = self._model_version
+        if self.evaluation is not None:
+            status["eval_metrics"] = self.evaluation.latest_metrics()
+            status["eval_rounds"] = self.evaluation.completed_rounds()
+        return status
+
+    # -- wiring --
+
+    def method_table(self) -> dict:
+        return {
+            name: getattr(self, name)
+            for name in (
+                "GetTask",
+                "ReportTaskResult",
+                "ReportVersion",
+                "RegisterWorker",
+                "Heartbeat",
+                "GetMembership",
+                "GetCheckpoint",
+                "ReportCheckpoint",
+                "JobStatus",
+            )
+        }
+
+
+class MasterServer:
+    """gRPC server hosting a MasterServicer on ``port`` (0 = ephemeral)."""
+
+    def __init__(self, servicer: MasterServicer, port: int = 0, max_workers: int = 32):
+        self.servicer = servicer
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+        self._server.add_generic_rpc_handlers(
+            (make_generic_handler(SERVICE_NAME, servicer.method_table()),)
+        )
+        self.port = self._server.add_insecure_port(f"[::]:{port}")
+
+    @property
+    def address(self) -> str:
+        return f"localhost:{self.port}"
+
+    def start(self) -> "MasterServer":
+        self._server.start()
+        logger.info("master gRPC service on %s", self.address)
+        return self
+
+    def stop(self, grace: float = 1.0) -> None:
+        self._server.stop(grace)

@@ -1,0 +1,174 @@
+"""Dynamic data sharding: the fault-tolerance core.
+
+Reference parity (SURVEY.md §2 #3, §3.2 [U — mount empty at survey time]):
+the master splits the dataset into shard-sized "tasks"; workers pull tasks
+over RPC and report results; tasks of a dead/slow worker are requeued so a
+preemption loses at most the in-flight shards.  Epochs are implemented by
+refilling the todo queue when a pass completes.
+
+Thread-safe: the RPC servicer calls from gRPC threads, the pod watcher from
+its own thread.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from elasticdl_tpu.data.reader import Shard
+
+TASK_TRAINING = "training"
+TASK_EVALUATION = "evaluation"
+TASK_PREDICTION = "prediction"
+
+
+@dataclasses.dataclass(frozen=True)
+class Task:
+    task_id: int
+    shard: Shard
+    type: str = TASK_TRAINING
+    epoch: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "task_id": self.task_id,
+            "shard": dataclasses.asdict(self.shard),
+            "type": self.type,
+            "epoch": self.epoch,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Task":
+        return cls(
+            task_id=d["task_id"],
+            shard=Shard(**d["shard"]),
+            type=d["type"],
+            epoch=d["epoch"],
+        )
+
+
+@dataclasses.dataclass
+class _Doing:
+    task: Task
+    worker_id: str
+    handed_at: float
+
+
+class TaskDispatcher:
+    """todo/doing/done task queues with requeue-on-failure semantics."""
+
+    def __init__(
+        self,
+        shards: List[Shard],
+        num_epochs: int = 1,
+        task_type: str = TASK_TRAINING,
+        task_timeout_s: float = 600.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if num_epochs < 1:
+            raise ValueError("num_epochs must be >= 1")
+        self._shards = list(shards)
+        self._num_epochs = num_epochs
+        self._task_type = task_type
+        self._timeout = task_timeout_s
+        self._clock = clock
+
+        self._lock = threading.Lock()
+        self._todo: deque = deque()
+        self._doing: Dict[int, _Doing] = {}
+        self._done_count = 0
+        self._failed_counts: Dict[int, int] = {}
+        self._next_task_id = 0
+        self._epoch = -1  # _refill brings it to 0
+        self._finished = not self._shards
+        self._refill()
+
+    # -- internal --
+
+    def _refill(self) -> None:
+        """Start the next epoch if the current one is exhausted."""
+        if self._finished or self._todo or self._doing:
+            return
+        if self._epoch + 1 >= self._num_epochs:
+            self._finished = True
+            return
+        self._epoch += 1
+        for shard in self._shards:
+            self._todo.append(
+                Task(self._next_task_id, shard, self._task_type, self._epoch)
+            )
+            self._next_task_id += 1
+
+    # -- worker-facing API (via servicer) --
+
+    def get_task(self, worker_id: str) -> Optional[Task]:
+        """Hand out the next task, or None if nothing is available.
+
+        None with ``finished() == False`` means "in-flight tasks remain;
+        poll again" (their failure may requeue work).
+        """
+        with self._lock:
+            self._requeue_timed_out()
+            self._refill()
+            if not self._todo:
+                return None
+            task = self._todo.popleft()
+            self._doing[task.task_id] = _Doing(task, worker_id, self._clock())
+            return task
+
+    def report(self, task_id: int, success: bool, worker_id: str = "") -> bool:
+        """Record a task result; requeue on failure.  Returns False for an
+        unknown/stale id (e.g. a task already requeued by the timeout path —
+        the late result is ignored, matching at-least-once semantics)."""
+        with self._lock:
+            entry = self._doing.pop(task_id, None)
+            if entry is None:
+                return False
+            if success:
+                self._done_count += 1
+            else:
+                self._failed_counts[task_id] = self._failed_counts.get(task_id, 0) + 1
+                self._todo.appendleft(entry.task)
+            self._refill()
+            return True
+
+    # -- elasticity hooks --
+
+    def recover_tasks(self, worker_id: str) -> List[Task]:
+        """Requeue every in-flight task of a dead worker (PodManager calls
+        this on a pod-failure event; §3.2 'elasticity core')."""
+        with self._lock:
+            lost = [d.task for d in self._doing.values() if d.worker_id == worker_id]
+            for task in lost:
+                del self._doing[task.task_id]
+                self._todo.appendleft(task)
+            return lost
+
+    def _requeue_timed_out(self) -> None:
+        now = self._clock()
+        stale = [
+            tid
+            for tid, d in self._doing.items()
+            if now - d.handed_at > self._timeout
+        ]
+        for tid in stale:
+            self._todo.appendleft(self._doing.pop(tid).task)
+
+    # -- introspection --
+
+    def finished(self) -> bool:
+        with self._lock:
+            return self._finished and not self._todo and not self._doing
+
+    def counts(self) -> dict:
+        with self._lock:
+            return {
+                "todo": len(self._todo),
+                "doing": len(self._doing),
+                "done": self._done_count,
+                "epoch": self._epoch,
+                "finished": self._finished and not self._todo and not self._doing,
+            }

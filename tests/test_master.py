@@ -1,0 +1,245 @@
+"""Master control plane: dispatcher queue/requeue semantics, rendezvous
+membership versioning, eval aggregation, and the servicer both via direct
+calls (no network — the reference's decisive test pattern, SURVEY.md §4) and
+over a real localhost gRPC channel."""
+
+import threading
+
+import pytest
+
+from elasticdl_tpu.common.rpc import JsonRpcClient
+from elasticdl_tpu.data.reader import Shard
+from elasticdl_tpu.master.evaluation_service import EvaluationService
+from elasticdl_tpu.master.rendezvous import RendezvousServer
+from elasticdl_tpu.master.servicer import MasterServer, MasterServicer
+from elasticdl_tpu.master.task_dispatcher import (
+    TASK_EVALUATION,
+    Task,
+    TaskDispatcher,
+)
+
+
+def _shards(n, size=10):
+    return [Shard("f", i * size, (i + 1) * size) for i in range(n)]
+
+
+class TestTaskDispatcher:
+    def test_handout_and_done(self):
+        d = TaskDispatcher(_shards(3))
+        tasks = [d.get_task("w0") for _ in range(3)]
+        assert all(t is not None for t in tasks)
+        assert d.get_task("w0") is None and not d.finished()
+        for t in tasks:
+            assert d.report(t.task_id, True)
+        assert d.finished()
+        assert d.counts()["done"] == 3
+
+    def test_failure_requeues(self):
+        d = TaskDispatcher(_shards(1))
+        t = d.get_task("w0")
+        d.report(t.task_id, False)
+        t2 = d.get_task("w1")
+        assert t2.shard == t.shard
+        d.report(t2.task_id, True)
+        assert d.finished()
+
+    def test_dead_worker_recovery(self):
+        d = TaskDispatcher(_shards(4))
+        got_w0 = [d.get_task("w0"), d.get_task("w0")]
+        d.get_task("w1")
+        lost = d.recover_tasks("w0")
+        assert {t.task_id for t in lost} == {t.task_id for t in got_w0}
+        # The lost shards are re-dispatchable; a late report from the dead
+        # worker is rejected as stale.
+        assert not d.report(got_w0[0].task_id, True)
+        remaining = []
+        while (t := d.get_task("w2")) is not None:
+            remaining.append(t)
+        assert len(remaining) == 3  # 2 recovered + 1 never handed out
+
+    def test_epochs_refill(self):
+        d = TaskDispatcher(_shards(2), num_epochs=3)
+        seen = 0
+        while not d.finished():
+            t = d.get_task("w0")
+            if t is None:
+                break
+            d.report(t.task_id, True)
+            seen += 1
+        assert seen == 6
+        assert d.counts()["epoch"] == 2
+
+    def test_timeout_requeue(self):
+        now = [0.0]
+        d = TaskDispatcher(_shards(1), task_timeout_s=5.0, clock=lambda: now[0])
+        t = d.get_task("w0")
+        now[0] = 10.0
+        t2 = d.get_task("w1")
+        assert t2 is not None and t2.shard == t.shard
+        # Task ids are stable across requeues (at-least-once): the slow
+        # worker's late success still completes the task...
+        assert d.report(t.task_id, True)
+        assert d.finished()
+        # ...and the re-handed copy's report is then stale.
+        assert not d.report(t2.task_id, True)
+
+    def test_task_serialization(self):
+        t = Task(7, Shard("file.rio", 10, 20), TASK_EVALUATION, epoch=1)
+        assert Task.from_dict(t.to_dict()) == t
+
+
+class TestRendezvous:
+    def test_versioned_membership(self):
+        r = RendezvousServer()
+        v1 = r.register("w0")
+        v2 = r.register("w1")
+        assert v2 == v1 + 1
+        assert r.register("w0") == v2  # idempotent re-register
+        m = r.membership()
+        assert m["workers"] == ["w0", "w1"]
+        assert m["ranks"] == {"w0": 0, "w1": 1}
+        v3 = r.remove("w0")
+        assert v3 == v2 + 1
+
+    def test_heartbeat_reaping(self):
+        now = [0.0]
+        r = RendezvousServer(heartbeat_timeout_s=10.0, clock=lambda: now[0])
+        r.register("w0")
+        r.register("w1")
+        now[0] = 8.0
+        r.heartbeat("w1")
+        now[0] = 15.0
+        assert r.reap_dead() == ["w0"]
+        assert r.membership()["workers"] == ["w1"]
+
+    def test_listener_fires(self):
+        r = RendezvousServer()
+        events = []
+        r.add_listener(lambda v, m: events.append((v, list(m))))
+        r.register("w0")
+        r.remove("w0")
+        assert events == [(1, ["w0"]), (2, [])]
+
+
+class TestEvaluationService:
+    def test_interval_trigger_and_aggregation(self):
+        ev = EvaluationService(_shards(2), evaluation_steps=100)
+        assert not ev.maybe_trigger(50)
+        assert ev.maybe_trigger(100)
+        assert not ev.maybe_trigger(150)  # round in flight
+        for _ in range(2):
+            t = ev.get_task("w0")
+            assert t.type == TASK_EVALUATION
+            ev.report_metrics({"accuracy": 0.5}, weight=10)
+            ev.report_task(t.task_id, True)
+        assert ev.completed_rounds() == 1
+        assert ev.latest_metrics()["accuracy"] == pytest.approx(0.5)
+        assert ev.maybe_trigger(250)
+
+    def test_weighted_aggregation(self):
+        ev = EvaluationService(_shards(2), evaluation_steps=1)
+        ev.trigger(1)
+        t1, t2 = ev.get_task("w0"), ev.get_task("w1")
+        ev.report_metrics({"acc": 1.0}, weight=30)
+        ev.report_task(t1.task_id, True)
+        ev.report_metrics({"acc": 0.0}, weight=10)
+        ev.report_task(t2.task_id, True)
+        assert ev.latest_metrics()["acc"] == pytest.approx(0.75)
+
+
+class TestServicer:
+    def _servicer(self, n_shards=4, eval_shards=0, evaluation_steps=0):
+        ev = (
+            EvaluationService(_shards(eval_shards), evaluation_steps)
+            if eval_shards
+            else None
+        )
+        return MasterServicer(TaskDispatcher(_shards(n_shards)), evaluation=ev)
+
+    def test_direct_task_loop(self):
+        s = self._servicer(2)
+        s.RegisterWorker({"worker_id": "w0"})
+        done = 0
+        while True:
+            resp = s.GetTask({"worker_id": "w0"})
+            if resp["task"] is None:
+                assert resp["finished"]
+                break
+            s.ReportTaskResult(
+                {"worker_id": "w0", "task_id": resp["task"]["task_id"],
+                 "success": True, "model_version": done + 1}
+            )
+            done += 1
+        assert done == 2
+        assert s.JobStatus({})["model_version"] == 2
+
+    def test_membership_change_requeues_tasks(self):
+        s = self._servicer(4)
+        s.RegisterWorker({"worker_id": "w0"})
+        s.RegisterWorker({"worker_id": "w1"})
+        s.GetTask({"worker_id": "w0"})
+        s.GetTask({"worker_id": "w1"})
+        s.rendezvous.remove("w0")  # pod death observed
+        status = s.JobStatus({})
+        assert status["todo"] == 3 and status["doing"] == 1
+
+    def test_eval_interleaving(self):
+        s = self._servicer(2, eval_shards=1, evaluation_steps=1)
+        s.ReportVersion({"worker_id": "w0", "model_version": 5})
+        resp = s.GetTask({"worker_id": "w0"})
+        assert resp["task"]["type"] == TASK_EVALUATION
+        s.ReportTaskResult(
+            {"worker_id": "w0", "task_id": resp["task"]["task_id"],
+             "success": True, "task_type": TASK_EVALUATION,
+             "metrics": {"accuracy": 0.9}, "weight": 10}
+        )
+        assert s.JobStatus({})["eval_metrics"]["accuracy"] == pytest.approx(0.9)
+        # Next task is a training one again.
+        assert s.GetTask({"worker_id": "w0"})["task"]["type"] == "training"
+
+    def test_checkpoint_tracking(self):
+        s = self._servicer()
+        s.ReportCheckpoint({"path": "/ckpt/10", "step": 10})
+        s.ReportCheckpoint({"path": "/ckpt/5", "step": 5})  # stale, ignored
+        assert s.GetCheckpoint({}) == {"path": "/ckpt/10", "step": 10}
+
+
+class TestGrpcTransport:
+    def test_full_loop_over_localhost(self):
+        servicer = MasterServicer(TaskDispatcher(_shards(8)))
+        server = MasterServer(servicer, port=0).start()
+        try:
+            client = JsonRpcClient(server.address)
+            client.wait_ready(10)
+            membership = client.call("RegisterWorker", {"worker_id": "w0"})
+            assert membership["world_size"] == 1
+
+            def run_worker(worker_id, out):
+                c = JsonRpcClient(server.address)
+                c.call("RegisterWorker", {"worker_id": worker_id})
+                while True:
+                    resp = c.call("GetTask", {"worker_id": worker_id})
+                    if resp["task"] is None:
+                        break
+                    c.call(
+                        "ReportTaskResult",
+                        {"worker_id": worker_id,
+                         "task_id": resp["task"]["task_id"], "success": True},
+                    )
+                    out.append(resp["task"]["task_id"])
+                c.close()
+
+            done: list = []
+            threads = [
+                threading.Thread(target=run_worker, args=(f"w{i}", done))
+                for i in range(3)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            assert len(done) == 8 and len(set(done)) == 8
+            assert servicer.dispatcher.finished()
+            client.close()
+        finally:
+            server.stop()
