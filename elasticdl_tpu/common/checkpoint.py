@@ -1,0 +1,66 @@
+"""Checkpoint save/restore on Orbax.
+
+Reference parity (SURVEY.md §2 #18, §5 [U]): the reference snapshots the
+model every ``--checkpoint_steps`` (PS shards dump their slices; in AllReduce
+mode worker-0 saves) and restores on restart — checkpoint restore is also how
+an elastically re-formed job resumes.  Here Orbax saves the full TrainState
+pytree — including mesh-sharded embedding tables, which Orbax reads/writes
+per-shard from each device's HBM — and restores it **into any mesh shape**,
+which is exactly the elastic 4->8->4 path: the checkpoint is
+topology-agnostic, the restore target's shardings belong to the new mesh.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("checkpoint")
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, keep_max: int = 3):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep_max, create=True, enable_async_checkpointing=True
+            ),
+        )
+
+    def save(self, step: int, state: Any, wait: bool = False) -> None:
+        """Async snapshot (training continues while Orbax writes)."""
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
+        """Restore into the sharding/structure of ``state_like`` (an abstract
+        or concrete TrainState whose arrays carry the TARGET mesh's
+        shardings — this is what makes restore-into-new-topology work)."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+            if hasattr(x, "sharding")
+            else jax.ShapeDtypeStruct(x.shape, x.dtype),
+            state_like,
+        )
+        return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()

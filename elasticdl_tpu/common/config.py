@@ -58,6 +58,7 @@ class JobConfig:
     training_data: str = ""
     validation_data: str = ""
     prediction_data: str = ""
+    prediction_outputs: str = ""  # dir for predict-mode outputs (.npy per task)
     data_reader_params: str = ""
 
     # --- schedule ---

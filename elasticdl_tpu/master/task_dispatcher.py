@@ -66,6 +66,7 @@ class TaskDispatcher:
         num_epochs: int = 1,
         task_type: str = TASK_TRAINING,
         task_timeout_s: float = 600.0,
+        max_task_retries: int = 3,
         clock: Callable[[], float] = time.monotonic,
     ):
         if num_epochs < 1:
@@ -74,12 +75,14 @@ class TaskDispatcher:
         self._num_epochs = num_epochs
         self._task_type = task_type
         self._timeout = task_timeout_s
+        self._max_retries = max_task_retries
         self._clock = clock
 
         self._lock = threading.Lock()
         self._todo: deque = deque()
         self._doing: Dict[int, _Doing] = {}
         self._done_count = 0
+        self._abandoned = 0
         self._failed_counts: Dict[int, int] = {}
         self._next_task_id = 0
         self._epoch = -1  # _refill brings it to 0
@@ -130,8 +133,14 @@ class TaskDispatcher:
             if success:
                 self._done_count += 1
             else:
-                self._failed_counts[task_id] = self._failed_counts.get(task_id, 0) + 1
-                self._todo.appendleft(entry.task)
+                fails = self._failed_counts.get(task_id, 0) + 1
+                self._failed_counts[task_id] = fails
+                if fails <= self._max_retries:
+                    self._todo.appendleft(entry.task)
+                else:
+                    # Poison task: a shard that fails deterministically (bad
+                    # data, codec mismatch) must not stall the job forever.
+                    self._abandoned += 1
             self._refill()
             return True
 
@@ -169,6 +178,7 @@ class TaskDispatcher:
                 "todo": len(self._todo),
                 "doing": len(self._doing),
                 "done": self._done_count,
+                "abandoned": self._abandoned,
                 "epoch": self._epoch,
                 "finished": self._finished and not self._todo and not self._doing,
             }

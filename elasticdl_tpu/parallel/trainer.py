@@ -141,6 +141,7 @@ class Trainer:
         self._state_specs = None
         self._train_step = None
         self._eval_step = None
+        self._predict_step = None
 
     # ---- elastic re-formation ----
 
@@ -158,6 +159,7 @@ class Trainer:
         self._state_specs = None
         self._train_step = None
         self._eval_step = None
+        self._predict_step = None
 
     # ---- state management ----
 
@@ -216,6 +218,13 @@ class Trainer:
             )
         return self._eval_step(state, batch)
 
+    def predict_step(self, state: TrainState, batch: Any):
+        if self._predict_step is None:
+            self._predict_step = build_predict_step(
+                self.spec, self.mesh, self.ctx, self.state_specs()
+            )
+        return self._predict_step(state, batch)
+
 
 def build_train_step(
     spec: ModelSpec, mesh: Mesh, ctx: ParallelContext, state_specs: TrainState
@@ -252,6 +261,27 @@ def build_train_step(
         check_vma=False,
     )
     return jax.jit(mapped, donate_argnums=(0,))
+
+
+def build_predict_step(
+    spec: ModelSpec, mesh: Mesh, ctx: ParallelContext, state_specs: TrainState
+) -> Callable:
+    """Per-example model outputs, batch-sharded in and out (the reference's
+    predict mode, SURVEY.md §2 #1 'predict')."""
+    axis = ctx.axis_name
+    assert axis is not None
+
+    def local_predict(state: TrainState, batch):
+        return spec.apply(state.params, batch, train=False, ctx=ctx)
+
+    mapped = shard_map(
+        local_predict,
+        mesh=mesh,
+        in_specs=(state_specs, P(axis)),
+        out_specs=P(axis),
+        check_vma=False,
+    )
+    return jax.jit(mapped)
 
 
 def build_eval_step(

@@ -1,0 +1,10 @@
+"""Worker: the task-pulling training/eval/predict loop.
+
+Reference parity (SURVEY.md §2 #7-9, §3.3-3.4 [U/D]): the worker registers
+with the master, pulls shard tasks over RPC, runs the jitted mesh step on
+each shard's minibatches, reports results/metrics, and on a membership-version
+change re-forms its mesh from the latest checkpoint (the reference's elastic
+Horovod retry path, §3.5).
+"""
+
+from elasticdl_tpu.worker.worker import DirectMasterProxy, RpcMasterProxy, Worker  # noqa: F401

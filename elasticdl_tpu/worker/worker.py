@@ -1,0 +1,283 @@
+"""The worker main loop.
+
+Reference parity (SURVEY.md §3.3-3.5 [U/D]): pull task -> build input from
+the shard -> jitted step per minibatch -> report; on membership change,
+re-form the mesh and resume from the latest checkpoint.  The reference's
+trainer split (AllReduceTrainer vs PS path) collapses into one Trainer whose
+partition specs differ by strategy (parallel/trainer.py).
+
+Deployment note: in a real multi-host TPU job each worker is one host of a
+``jax.distributed``-initialized slice and the mesh spans all hosts' devices;
+in-process tests emulate elasticity by resizing the mesh over a fixed pool of
+fake CPU devices (SURVEY.md §4 pattern).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from elasticdl_tpu.common.checkpoint import CheckpointManager
+from elasticdl_tpu.common.config import JobConfig
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.common.rpc import JsonRpcClient
+from elasticdl_tpu.data.reader import AbstractDataReader
+from elasticdl_tpu.master.task_dispatcher import (
+    TASK_EVALUATION,
+    TASK_PREDICTION,
+    TASK_TRAINING,
+    Task,
+)
+from elasticdl_tpu.models.spec import ModelSpec, load_model_spec_for_job
+from elasticdl_tpu.parallel.mesh import create_mesh
+from elasticdl_tpu.parallel.trainer import Trainer
+
+logger = get_logger("worker")
+
+
+class DirectMasterProxy:
+    """In-process master (the reference's no-cluster test pattern)."""
+
+    def __init__(self, servicer):
+        self._s = servicer
+
+    def call(self, method: str, request: dict) -> dict:
+        return self._s.method_table()[method](request)
+
+
+class RpcMasterProxy:
+    def __init__(self, address: str, timeout_s: float = 30.0):
+        self._client = JsonRpcClient(address)
+        self._client.wait_ready(timeout_s)
+
+    def call(self, method: str, request: dict) -> dict:
+        return self._client.call(method, request)
+
+
+def _minibatches(
+    records: List[bytes], batch_size: int, train: bool
+) -> Iterable[tuple]:
+    """Split shard records into fixed-size minibatches (static shapes for
+    XLA).  The tail is wrap-padded to full size; yields (records, true_count)
+    so eval weighting can use the real example count."""
+    for start in range(0, len(records), batch_size):
+        chunk = records[start : start + batch_size]
+        true_count = len(chunk)
+        if true_count < batch_size:
+            reps = (batch_size + true_count - 1) // true_count
+            chunk = (chunk * reps)[:batch_size]
+        yield chunk, true_count
+
+
+class Worker:
+    def __init__(
+        self,
+        config: JobConfig,
+        master,
+        reader: AbstractDataReader,
+        worker_id: str = "worker-0",
+        spec: Optional[ModelSpec] = None,
+        devices: Optional[Sequence[jax.Device]] = None,
+        devices_per_worker: int = 0,
+        poll_interval_s: float = 0.05,
+    ):
+        self.config = config
+        self.master = master
+        self.reader = reader
+        self.worker_id = worker_id
+        self.spec = spec or load_model_spec_for_job(config)
+        self._pool = list(devices) if devices is not None else list(jax.devices())
+        self._dpw = devices_per_worker or len(self._pool)
+        self._poll = poll_interval_s
+
+        self.trainer: Optional[Trainer] = None
+        self.state = None
+        self._membership_version = -1
+        self._rank = 0
+        self._ckpt: Optional[CheckpointManager] = None
+        self._last_ckpt_step = 0
+        self.reforms = 0  # elastic mesh re-formations (observability/tests)
+
+        if config.checkpoint_dir:
+            self._ckpt = CheckpointManager(
+                config.checkpoint_dir, keep_max=config.keep_checkpoint_max
+            )
+
+    # ---- membership / elasticity ----
+
+    def _mesh_size(self, world_size: int) -> int:
+        return max(1, min(world_size * self._dpw, len(self._pool)))
+
+    def _apply_membership(self, membership: dict, initial: bool = False) -> None:
+        version = membership["version"]
+        if version == self._membership_version:
+            return
+        world = max(membership["world_size"], 1)
+        self._rank = membership["ranks"].get(self.worker_id, 0)
+        mesh = create_mesh(self._pool, num_devices=self._mesh_size(world))
+        if initial or self.trainer is None:
+            self.trainer = Trainer(self.spec, self.config, mesh)
+        else:
+            self.reforms += 1
+            logger.info(
+                "membership v%d -> re-forming mesh to %d devices",
+                version, mesh.devices.size,
+            )
+            self.trainer.set_mesh(mesh)
+            self._replace_state()
+        self._membership_version = version
+
+    def _replace_state(self) -> None:
+        """Re-place state on the re-formed mesh: restore the latest checkpoint
+        if one exists (the reference's recover-from-snapshot path), else
+        re-shard the live state (pure in-process resize)."""
+        assert self.trainer is not None
+        restored = None
+        if self._ckpt is not None and self._ckpt.latest_step() is not None:
+            self._ckpt.wait()
+            template = self.trainer.shard_state(jax.device_get(self.state))
+            restored = self._ckpt.restore(template)
+            logger.info("restored checkpoint step %d", int(restored.step))
+        if restored is None:
+            restored = self.trainer.shard_state(jax.device_get(self.state))
+        self.state = restored
+
+    def _check_membership(self) -> None:
+        resp = self.master.call("Heartbeat", {"worker_id": self.worker_id})
+        if resp["version"] != self._membership_version:
+            membership = self.master.call("GetMembership", {})
+            self._apply_membership(membership)
+
+    # ---- checkpointing ----
+
+    def _maybe_checkpoint(self) -> None:
+        if self._ckpt is None or self.config.checkpoint_steps <= 0:
+            return
+        step = int(self.state.step)
+        if step - self._last_ckpt_step < self.config.checkpoint_steps:
+            return
+        if self._rank == 0:
+            self._ckpt.save(step, jax.device_get(self.state))
+            self._last_ckpt_step = step
+            self.master.call(
+                "ReportCheckpoint",
+                {"path": self._ckpt.directory, "step": step},
+            )
+
+    # ---- task execution ----
+
+    def _run_training_task(self, task: Task) -> Dict[str, float]:
+        records = list(self.reader.read_records(task.shard))
+        metrics: Dict[str, Any] = {}
+        for chunk, _ in _minibatches(records, self.config.minibatch_size, True):
+            batch = self.spec.feed(chunk)
+            self.state, metrics = self.trainer.train_step(
+                self.state, self.trainer.shard_batch(batch)
+            )
+        return {k: float(v) for k, v in metrics.items()}
+
+    def _run_evaluation_task(self, task: Task) -> tuple:
+        records = list(self.reader.read_records(task.shard))
+        sums: Dict[str, float] = {}
+        total = 0.0
+        for chunk, true_count in _minibatches(
+            records, self.config.minibatch_size, False
+        ):
+            batch = self.spec.feed(chunk)
+            metrics = self.trainer.eval_step(
+                self.state, self.trainer.shard_batch(batch)
+            )
+            for k, v in metrics.items():
+                sums[k] = sums.get(k, 0.0) + float(v) * true_count
+            total += true_count
+        return {k: s / max(total, 1e-12) for k, s in sums.items()}, total
+
+    def _run_prediction_task(self, task: Task) -> None:
+        records = list(self.reader.read_records(task.shard))
+        outs = []
+        for chunk, true_count in _minibatches(
+            records, self.config.minibatch_size, False
+        ):
+            batch = self.spec.feed(chunk)
+            out = self.trainer.predict_step(
+                self.state, self.trainer.shard_batch(batch)
+            )
+            outs.append(np.asarray(out)[:true_count])
+        if self.config.prediction_outputs:
+            os.makedirs(self.config.prediction_outputs, exist_ok=True)
+            np.save(
+                os.path.join(
+                    self.config.prediction_outputs, f"task-{task.task_id}.npy"
+                ),
+                np.concatenate(outs, axis=0),
+            )
+
+    # ---- main loop ----
+
+    def run(self) -> Dict[str, Any]:
+        membership = self.master.call("RegisterWorker", {"worker_id": self.worker_id})
+        self._apply_membership(membership, initial=True)
+        if self.state is None:
+            self.state = self.trainer.init_state(jax.random.key(0))
+            # Elastic re-join: adopt the job's latest snapshot if one exists.
+            ckpt_info = self.master.call("GetCheckpoint", {})
+            if ckpt_info.get("path") and self._ckpt is not None:
+                try:
+                    self.state = self._ckpt.restore(self.state)
+                    logger.info("joined from checkpoint step %d", int(self.state.step))
+                except FileNotFoundError:
+                    pass
+
+        tasks_done = 0
+        while True:
+            self._check_membership()
+            resp = self.master.call("GetTask", {"worker_id": self.worker_id})
+            if resp["task"] is None:
+                if resp["finished"]:
+                    break
+                time.sleep(self._poll)
+                continue
+            task = Task.from_dict(resp["task"])
+            report = {
+                "worker_id": self.worker_id,
+                "task_id": task.task_id,
+                "task_type": task.type,
+                "success": True,
+            }
+            try:
+                if task.type == TASK_TRAINING:
+                    metrics = self._run_training_task(task)
+                    report["metrics"] = metrics
+                    report["model_version"] = int(self.state.step)
+                elif task.type == TASK_EVALUATION:
+                    metrics, weight = self._run_evaluation_task(task)
+                    report["metrics"] = metrics
+                    report["weight"] = weight
+                elif task.type == TASK_PREDICTION:
+                    self._run_prediction_task(task)
+                else:
+                    raise ValueError(f"unknown task type {task.type}")
+            except Exception:
+                logger.exception("task %d failed", task.task_id)
+                report["success"] = False
+            self.master.call("ReportTaskResult", report)
+            if report["success"]:
+                tasks_done += 1
+                self._maybe_checkpoint()
+
+        # Final checkpoint so a completed job is resumable/servable.
+        if self._ckpt is not None and self._rank == 0 and self.state is not None:
+            self._ckpt.save(int(self.state.step), jax.device_get(self.state), wait=True)
+            self.master.call(
+                "ReportCheckpoint",
+                {"path": self._ckpt.directory, "step": int(self.state.step)},
+            )
+        return {
+            "tasks_done": tasks_done,
+            "step": int(self.state.step) if self.state is not None else 0,
+            "reforms": self.reforms,
+        }

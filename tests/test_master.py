@@ -83,6 +83,16 @@ class TestTaskDispatcher:
         # ...and the re-handed copy's report is then stale.
         assert not d.report(t2.task_id, True)
 
+    def test_poison_task_abandoned_after_max_retries(self):
+        d = TaskDispatcher(_shards(1), max_task_retries=2)
+        for _ in range(3):  # initial attempt + 2 retries
+            t = d.get_task("w0")
+            assert t is not None
+            d.report(t.task_id, False)
+        assert d.get_task("w0") is None
+        assert d.finished()
+        assert d.counts()["abandoned"] == 1
+
     def test_task_serialization(self):
         t = Task(7, Shard("file.rio", 10, 20), TASK_EVALUATION, epoch=1)
         assert Task.from_dict(t.to_dict()) == t
