@@ -1,0 +1,13 @@
+"""Native host tier: C++ embedding store + recordio scanner (ctypes).
+
+Reference parity (SURVEY.md §2 #10-#11): the reference's native code is its
+Go parameter server (embedding KV store, server-side sparse optimizers,
+checkpoint dump) and its kernels.  Here the sharded fast path is HBM-resident
+(ops/embedding.py); this package is the C++ host tier for beyond-HBM tables
+and the native ingest scanner.
+"""
+
+from elasticdl_tpu.ps.host_store import (  # noqa: F401
+    HostEmbeddingStore,
+    native_lib_available,
+)

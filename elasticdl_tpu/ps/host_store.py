@@ -1,0 +1,179 @@
+"""ctypes bindings for the native host embedding store (ps/native/).
+
+The shared library is built on first use (g++ is in the image; no pybind11,
+per environment constraints).  All APIs take/return numpy arrays; ids are
+int64, rows float32.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("ps.host_store")
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libedl_native.so")
+_OPTIMIZERS = {"sgd": 0, "momentum": 1, "adagrad": 2, "adam": 3}
+
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_error: Optional[str] = None
+
+_i64 = ctypes.c_int64
+_i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+_f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+
+
+def _build() -> None:
+    subprocess.run(
+        ["make", "-s", "-C", _NATIVE_DIR],
+        check=True,
+        capture_output=True,
+        text=True,
+    )
+
+
+def _load() -> ctypes.CDLL:
+    global _lib, _lib_error
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if _lib_error is not None:
+            raise RuntimeError(_lib_error)
+        try:
+            src = os.path.join(_NATIVE_DIR, "edl_native.cc")
+            if not os.path.exists(_LIB_PATH) or os.path.getmtime(
+                _LIB_PATH
+            ) < os.path.getmtime(src):
+                _build()
+            lib = ctypes.CDLL(_LIB_PATH)
+        except (subprocess.CalledProcessError, OSError) as e:
+            _lib_error = f"native lib unavailable: {e}"
+            raise RuntimeError(_lib_error) from e
+
+        lib.edl_store_create.restype = ctypes.c_void_p
+        lib.edl_store_create.argtypes = [
+            _i64, ctypes.c_int,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_float,
+        ]
+        lib.edl_store_destroy.argtypes = [ctypes.c_void_p]
+        lib.edl_store_size.restype = _i64
+        lib.edl_store_size.argtypes = [ctypes.c_void_p]
+        lib.edl_store_pull.argtypes = [ctypes.c_void_p, _i64p, _i64, _f32p]
+        lib.edl_store_push_grad.argtypes = [ctypes.c_void_p, _i64p, _i64, _f32p]
+        lib.edl_store_save.restype = _i64
+        lib.edl_store_save.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.edl_store_load.restype = _i64
+        lib.edl_store_load.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.edl_recordio_index.restype = _i64
+        lib.edl_recordio_index.argtypes = [ctypes.c_char_p, _i64p, _i64]
+        lib.edl_recordio_verify.restype = _i64
+        lib.edl_recordio_verify.argtypes = [ctypes.c_char_p, _i64p, _i64, _i64]
+        _lib = lib
+        return lib
+
+
+def native_lib_available() -> bool:
+    try:
+        _load()
+        return True
+    except RuntimeError:
+        return False
+
+
+class HostEmbeddingStore:
+    """Growable id->row store with server-side sparse optimizers.
+
+    The host tier of the ParameterServer strategy: rows materialize on first
+    pull (deterministic per-id init), ``push_grad`` applies one optimizer
+    step per distinct id with duplicate contributions pre-accumulated
+    (IndexedSlices semantics — same contract the mesh-sharded path's AD
+    transpose provides on-device).
+    """
+
+    def __init__(
+        self,
+        dim: int,
+        optimizer: str = "adagrad",
+        learning_rate: float = 0.01,
+        momentum: float = 0.9,
+        beta1: float = 0.9,
+        beta2: float = 0.999,
+        eps: float = 1e-8,
+        init_scale: float = 0.05,
+    ):
+        if optimizer not in _OPTIMIZERS:
+            raise ValueError(
+                f"unknown optimizer {optimizer!r}, pick from {sorted(_OPTIMIZERS)}"
+            )
+        self._lib = _load()
+        self.dim = dim
+        self.optimizer = optimizer
+        self._ptr = self._lib.edl_store_create(
+            dim, _OPTIMIZERS[optimizer],
+            learning_rate, momentum, beta1, beta2, eps, init_scale,
+        )
+
+    def __len__(self) -> int:
+        return int(self._lib.edl_store_size(self._ptr))
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        ids = np.ascontiguousarray(ids, np.int64)
+        out = np.empty((ids.size, self.dim), np.float32)
+        self._lib.edl_store_pull(self._ptr, ids.ravel(), ids.size, out)
+        return out.reshape(ids.shape + (self.dim,))
+
+    def push_grad(self, ids: np.ndarray, grads: np.ndarray) -> None:
+        ids = np.ascontiguousarray(ids, np.int64).ravel()
+        grads = np.ascontiguousarray(grads, np.float32).reshape(ids.size, self.dim)
+        self._lib.edl_store_push_grad(self._ptr, ids, ids.size, grads)
+
+    def save(self, path: str) -> int:
+        n = int(self._lib.edl_store_save(self._ptr, path.encode()))
+        if n < 0:
+            raise IOError(f"save to {path} failed")
+        return n
+
+    def load(self, path: str) -> int:
+        n = int(self._lib.edl_store_load(self._ptr, path.encode()))
+        if n == -2:
+            raise ValueError("checkpoint optimizer/dim mismatch")
+        if n < 0:
+            raise IOError(f"load from {path} failed")
+        return n
+
+    def close(self) -> None:
+        if self._ptr:
+            self._lib.edl_store_destroy(self._ptr)
+            self._ptr = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def recordio_index_native(path: str, max_records: int = 1 << 24) -> np.ndarray:
+    """Native recordio offset scan (data/recordio.py's fast path)."""
+    lib = _load()
+    offsets = np.empty((max_records,), np.int64)
+    n = int(lib.edl_recordio_index(path.encode(), offsets, max_records))
+    if n < 0:
+        raise IOError(f"{path}: malformed recordio")
+    return offsets[:n].copy()
+
+
+def recordio_verify_native(path: str, offsets: np.ndarray, start: int, end: int) -> int:
+    lib = _load()
+    offsets = np.ascontiguousarray(offsets, np.int64)
+    return int(lib.edl_recordio_verify(path.encode(), offsets, start, end))

@@ -1,0 +1,301 @@
+// Native runtime pieces (C ABI, loaded via ctypes).
+//
+// Reference parity (SURVEY.md §2 #10-#11 [U/D]): the reference's native
+// components are a Go parameter server — an embedding-table KV store with
+// server-side sparse optimizers (SGD/Adagrad/Adam) and checkpoint dump/load —
+// plus vectorized apply-gradient kernels.  TPU-first re-design: the *sharded*
+// embedding path lives in HBM on the mesh (ops/embedding.py); THIS store is
+// the host tier for tables that exceed HBM — the worker pulls the batch's
+// unique rows to the device, computes dense grads for them, and pushes the
+// sparse update back here, where the optimizer applies it in place.  Also
+// includes the recordio range-scanner used on the ingest hot path.
+//
+// Build: see Makefile (g++ -O3 -shared).  No external deps beyond libc++.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <cmath>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------- utilities
+
+// splitmix64: deterministic per-id seed for default row init.
+static inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}
+
+// crc32 (IEEE, reflected) — table generated on first use.
+static uint32_t crc_table[256];
+static bool crc_ready = false;
+static void crc_init() {
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++) c = (c & 1) ? 0xedb88320u ^ (c >> 1) : c >> 1;
+    crc_table[i] = c;
+  }
+  crc_ready = true;
+}
+static uint32_t crc32_buf(const uint8_t* p, size_t n) {
+  if (!crc_ready) crc_init();
+  uint32_t c = 0xffffffffu;
+  for (size_t i = 0; i < n; i++) c = crc_table[(c ^ p[i]) & 0xff] ^ (c >> 8);
+  return c ^ 0xffffffffu;
+}
+
+// ------------------------------------------------------- embedding KV store
+
+enum Optimizer { OPT_SGD = 0, OPT_MOMENTUM = 1, OPT_ADAGRAD = 2, OPT_ADAM = 3 };
+
+struct EdlStore {
+  int64_t dim;
+  int opt;
+  float lr, momentum, beta1, beta2, eps;
+  float init_scale;
+  // stride = weights + optimizer slots, all contiguous per row.
+  int64_t stride;
+  std::unordered_map<int64_t, int64_t> index;  // id -> row offset (in floats)
+  std::vector<float> arena;
+  std::vector<int64_t> ids_in_order;  // for checkpoint iteration stability
+  std::vector<int32_t> adam_t;        // per-row step count (Adam only)
+
+  int64_t slots() const {
+    switch (opt) {
+      case OPT_SGD: return 0;
+      case OPT_MOMENTUM: return 1;
+      case OPT_ADAGRAD: return 1;
+      case OPT_ADAM: return 2;
+    }
+    return 0;
+  }
+
+  float* row(int64_t id, bool create) {
+    auto it = index.find(id);
+    if (it != index.end()) return arena.data() + it->second;
+    if (!create) return nullptr;
+    int64_t off = (int64_t)arena.size();
+    arena.resize(arena.size() + stride, 0.0f);
+    float* r = arena.data() + off;
+    uint64_t s = splitmix64((uint64_t)id);
+    for (int64_t d = 0; d < dim; d++) {
+      s = splitmix64(s);
+      // uniform in [-init_scale, init_scale)
+      r[d] = init_scale * (2.0f * (float)((s >> 11) * (1.0 / 9007199254740992.0)) - 1.0f);
+    }
+    index.emplace(id, off);
+    ids_in_order.push_back(id);
+    if (opt == OPT_ADAM) adam_t.push_back(0);
+    return r;
+  }
+};
+
+EdlStore* edl_store_create(int64_t dim, int optimizer, float lr, float momentum,
+                           float beta1, float beta2, float eps,
+                           float init_scale) {
+  EdlStore* s = new EdlStore();
+  s->dim = dim;
+  s->opt = optimizer;
+  s->lr = lr;
+  s->momentum = momentum;
+  s->beta1 = beta1;
+  s->beta2 = beta2;
+  s->eps = eps;
+  s->init_scale = init_scale;
+  s->stride = dim * (1 + s->slots());
+  return s;
+}
+
+void edl_store_destroy(EdlStore* s) { delete s; }
+
+int64_t edl_store_size(EdlStore* s) { return (int64_t)s->index.size(); }
+
+// Gather rows for n ids into out[n*dim]; rows for unseen ids are initialized.
+void edl_store_pull(EdlStore* s, const int64_t* ids, int64_t n, float* out) {
+  for (int64_t i = 0; i < n; i++) {
+    const float* r = s->row(ids[i], /*create=*/true);
+    std::memcpy(out + i * s->dim, r, sizeof(float) * s->dim);
+  }
+}
+
+// Sparse apply: ids may contain duplicates — contributions are accumulated
+// before one optimizer step per distinct row (IndexedSlices semantics).
+void edl_store_push_grad(EdlStore* s, const int64_t* ids, int64_t n,
+                         const float* grads) {
+  const int64_t dim = s->dim;
+  std::unordered_map<int64_t, std::vector<float>> acc;
+  acc.reserve(n * 2);
+  for (int64_t i = 0; i < n; i++) {
+    auto& g = acc[ids[i]];
+    if (g.empty()) g.assign(dim, 0.0f);
+    const float* gi = grads + i * dim;
+    for (int64_t d = 0; d < dim; d++) g[d] += gi[d];
+  }
+  for (auto& kv : acc) {
+    float* w = s->row(kv.first, /*create=*/true);
+    float* g = kv.second.data();
+    switch (s->opt) {
+      case OPT_SGD: {
+        for (int64_t d = 0; d < dim; d++) w[d] -= s->lr * g[d];
+        break;
+      }
+      case OPT_MOMENTUM: {
+        float* m = w + dim;
+        for (int64_t d = 0; d < dim; d++) {
+          m[d] = s->momentum * m[d] + g[d];
+          w[d] -= s->lr * m[d];
+        }
+        break;
+      }
+      case OPT_ADAGRAD: {
+        float* a = w + dim;
+        for (int64_t d = 0; d < dim; d++) {
+          a[d] += g[d] * g[d];
+          w[d] -= s->lr * g[d] / (std::sqrt(a[d]) + s->eps);
+        }
+        break;
+      }
+      case OPT_ADAM: {
+        float* m = w + dim;
+        float* v = w + 2 * dim;
+        int64_t row_i = (int64_t)(s->index[kv.first] / s->stride);
+        int32_t t = ++s->adam_t[row_i];
+        const float bc1 = 1.0f - std::pow(s->beta1, (float)t);
+        const float bc2 = 1.0f - std::pow(s->beta2, (float)t);
+        for (int64_t d = 0; d < dim; d++) {
+          m[d] = s->beta1 * m[d] + (1.0f - s->beta1) * g[d];
+          v[d] = s->beta2 * v[d] + (1.0f - s->beta2) * g[d] * g[d];
+          const float mh = m[d] / bc1, vh = v[d] / bc2;
+          w[d] -= s->lr * mh / (std::sqrt(vh) + s->eps);
+        }
+        break;
+      }
+    }
+  }
+}
+
+// Checkpoint: [int64 n][int64 dim][int64 stride][int32 opt]
+//             then per row: [int64 id][int32 adam_t][stride floats]
+int64_t edl_store_save(EdlStore* s, const char* path) {
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return -1;
+  int64_t n = (int64_t)s->index.size();
+  std::fwrite(&n, 8, 1, f);
+  std::fwrite(&s->dim, 8, 1, f);
+  std::fwrite(&s->stride, 8, 1, f);
+  int32_t opt = s->opt;
+  std::fwrite(&opt, 4, 1, f);
+  for (int64_t i = 0; i < n; i++) {
+    int64_t id = s->ids_in_order[i];
+    int64_t off = s->index[id];
+    int32_t t = (s->opt == OPT_ADAM) ? s->adam_t[off / s->stride] : 0;
+    std::fwrite(&id, 8, 1, f);
+    std::fwrite(&t, 4, 1, f);
+    std::fwrite(s->arena.data() + off, sizeof(float), s->stride, f);
+  }
+  std::fclose(f);
+  return n;
+}
+
+int64_t edl_store_load(EdlStore* s, const char* path) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  int64_t n, dim, stride;
+  int32_t opt;
+  if (std::fread(&n, 8, 1, f) != 1 || std::fread(&dim, 8, 1, f) != 1 ||
+      std::fread(&stride, 8, 1, f) != 1 || std::fread(&opt, 4, 1, f) != 1) {
+    std::fclose(f);
+    return -1;
+  }
+  if (dim != s->dim || stride != s->stride || opt != s->opt) {
+    std::fclose(f);
+    return -2;  // store configuration mismatch
+  }
+  s->index.clear();
+  s->arena.clear();
+  s->ids_in_order.clear();
+  s->adam_t.clear();
+  s->arena.reserve((size_t)n * stride);
+  for (int64_t i = 0; i < n; i++) {
+    int64_t id;
+    int32_t t;
+    if (std::fread(&id, 8, 1, f) != 1 || std::fread(&t, 4, 1, f) != 1) {
+      std::fclose(f);
+      return -1;
+    }
+    int64_t off = (int64_t)s->arena.size();
+    s->arena.resize(s->arena.size() + stride);
+    if (std::fread(s->arena.data() + off, sizeof(float), stride, f) !=
+        (size_t)stride) {
+      std::fclose(f);
+      return -1;
+    }
+    s->index.emplace(id, off);
+    s->ids_in_order.push_back(id);
+    if (s->opt == OPT_ADAM) s->adam_t.push_back(t);
+  }
+  std::fclose(f);
+  return n;
+}
+
+// --------------------------------------------------------- recordio scanner
+
+// Scan an EDLRIO file, filling offsets[] (record byte offsets) up to
+// max_records.  Returns the number of records found, or -1 on malformed
+// input.  Mirrors data/recordio.py (the format's source of truth).
+int64_t edl_recordio_index(const char* path, int64_t* offsets,
+                           int64_t max_records) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  char magic[8];
+  if (std::fread(magic, 1, 8, f) != 8 ||
+      std::memcmp(magic, "EDLRIO\x00\x01", 8) != 0) {
+    std::fclose(f);
+    return -1;
+  }
+  std::fseek(f, 0, SEEK_END);
+  const int64_t size = std::ftell(f);
+  int64_t pos = 8, n = 0;
+  while (pos < size && n < max_records) {
+    uint32_t hdr[2];
+    std::fseek(f, pos, SEEK_SET);
+    if (std::fread(hdr, 4, 2, f) != 2) { std::fclose(f); return -1; }
+    offsets[n++] = pos;
+    pos += 8 + (int64_t)hdr[0];
+  }
+  std::fclose(f);
+  return (pos > size) ? -1 : n;
+}
+
+// CRC-verify records [start, end) given their offsets; returns the index of
+// the first corrupt record, or -1 if all pass.
+int64_t edl_recordio_verify(const char* path, const int64_t* offsets,
+                            int64_t start, int64_t end) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return start;
+  std::vector<uint8_t> buf;
+  for (int64_t i = start; i < end; i++) {
+    uint32_t hdr[2];
+    std::fseek(f, offsets[i], SEEK_SET);
+    if (std::fread(hdr, 4, 2, f) != 2) { std::fclose(f); return i; }
+    buf.resize(hdr[0]);
+    if (hdr[0] && std::fread(buf.data(), 1, hdr[0], f) != hdr[0]) {
+      std::fclose(f);
+      return i;
+    }
+    if (crc32_buf(buf.data(), buf.size()) != hdr[1]) {
+      std::fclose(f);
+      return i;
+    }
+  }
+  std::fclose(f);
+  return -1;
+}
+
+}  // extern "C"
