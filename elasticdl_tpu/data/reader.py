@@ -40,6 +40,10 @@ class AbstractDataReader:
     def read_records(self, shard: Shard) -> Iterator[bytes]:
         raise NotImplementedError
 
+    def sources(self) -> List[str]:
+        """The source names this reader can serve shards for."""
+        raise NotImplementedError
+
 
 def _expand(path_spec: str) -> List[str]:
     """A data path may be a file, a directory, or a glob."""
@@ -73,6 +77,9 @@ class RecordIODataReader(AbstractDataReader):
 
     def read_records(self, shard: Shard) -> Iterator[bytes]:
         return self._readers[shard.name].read_range(shard.start, shard.end)
+
+    def sources(self) -> List[str]:
+        return sorted(self._readers)
 
 
 class CSVDataReader(AbstractDataReader):
@@ -108,6 +115,39 @@ class CSVDataReader(AbstractDataReader):
             f.seek(offsets[shard.start])
             for _ in range(shard.end - shard.start):
                 yield f.readline().rstrip(b"\r\n")
+
+    def sources(self) -> List[str]:
+        return list(self._files)
+
+
+class CompositeDataReader(AbstractDataReader):
+    """Routes shards by source name across several readers.
+
+    A worker serves training AND evaluation (and prediction) tasks from one
+    task queue, but those tasks' shards name files from different datasets;
+    this reader dispatches each shard to the reader that owns its source.
+    """
+
+    def __init__(self, readers: List[AbstractDataReader]):
+        self._readers = list(readers)
+        self._by_source: Dict[str, AbstractDataReader] = {}
+        for reader in self._readers:
+            for source in reader.sources():
+                self._by_source[source] = reader
+
+    def create_shards(self, records_per_shard: int) -> List[Shard]:
+        return [
+            s for r in self._readers for s in r.create_shards(records_per_shard)
+        ]
+
+    def read_records(self, shard: Shard) -> Iterator[bytes]:
+        reader = self._by_source.get(shard.name)
+        if reader is None:
+            raise KeyError(f"no reader serves source {shard.name!r}")
+        return reader.read_records(shard)
+
+    def sources(self) -> List[str]:
+        return sorted(self._by_source)
 
 
 _READERS = {

@@ -115,6 +115,10 @@ class EvaluationService:
         with self._lock:
             return self._completed_rounds
 
+    def enabled(self) -> bool:
+        """False when there is no validation data to evaluate on."""
+        return bool(self._shards)
+
     def round_in_flight(self) -> bool:
         with self._lock:
             return self._dispatcher is not None and not self._dispatcher.finished()

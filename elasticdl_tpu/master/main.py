@@ -1,0 +1,161 @@
+"""Master pod entry point — job orchestration.
+
+Reference parity (SURVEY.md §2 #2, §3.1-3.2 [U]): the master process wires
+together the task dispatcher (dynamic sharding), the rendezvous server
+(elastic membership), the evaluation service, the gRPC servicer, and the
+PodManager (worker fleet), then supervises the job to completion:
+
+- dead-worker reaping (stale heartbeats -> membership bump -> task requeue),
+- pod failure events -> membership removal + relaunch (PodManager policy),
+- end-of-job: final eval round, fleet teardown, job status summary.
+
+Run as ``python -m elasticdl_tpu.master.main`` (the CLI's train/evaluate/
+predict subcommands spawn exactly this), or embed via ``Master`` for tests.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Dict, List, Optional
+
+from elasticdl_tpu.common.config import JobConfig, parse_args
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.data.reader import create_data_reader
+from elasticdl_tpu.master.evaluation_service import EvaluationService
+from elasticdl_tpu.master.pod_manager import (
+    PodBackend,
+    PodManager,
+    PodPhase,
+    ProcessPodBackend,
+)
+from elasticdl_tpu.master.rendezvous import RendezvousServer
+from elasticdl_tpu.master.servicer import MasterServer, MasterServicer
+from elasticdl_tpu.master.task_dispatcher import (
+    TASK_EVALUATION,
+    TASK_PREDICTION,
+    TASK_TRAINING,
+    TaskDispatcher,
+)
+
+logger = get_logger("master.main")
+
+
+class Master:
+    """One training/evaluation/prediction job, master side."""
+
+    def __init__(
+        self,
+        config: JobConfig,
+        pod_backend: Optional[PodBackend] = None,
+        port: int = 0,
+        heartbeat_timeout_s: float = 30.0,
+    ):
+        config.validate()
+        self.config = config
+        records_per_task = (
+            config.minibatch_size * config.num_minibatches_per_task
+        )
+
+        # -- task queues from the job's datasets --
+        if config.job_type == "training":
+            primary, task_type = config.training_data, TASK_TRAINING
+        elif config.job_type == "evaluation":
+            primary, task_type = config.validation_data, TASK_EVALUATION
+        else:
+            primary, task_type = config.prediction_data, TASK_PREDICTION
+        if not primary:
+            raise ValueError(f"no data path configured for {config.job_type}")
+        reader = create_data_reader(
+            primary, config.parsed_data_reader_params()
+        )
+        self.dispatcher = TaskDispatcher(
+            reader.create_shards(records_per_task),
+            num_epochs=config.num_epochs if config.job_type == "training" else 1,
+            task_type=task_type,
+            task_timeout_s=config.task_timeout_s,
+        )
+        self.evaluation: Optional[EvaluationService] = None
+        if config.job_type == "training" and config.validation_data:
+            eval_reader = create_data_reader(
+                config.validation_data, config.parsed_data_reader_params()
+            )
+            self.evaluation = EvaluationService(
+                eval_reader.create_shards(records_per_task),
+                evaluation_steps=config.evaluation_steps,
+            )
+
+        # -- control plane --
+        self.rendezvous = RendezvousServer(
+            heartbeat_timeout_s=heartbeat_timeout_s
+        )
+        self.servicer = MasterServicer(
+            self.dispatcher,
+            rendezvous=self.rendezvous,
+            evaluation=self.evaluation,
+            final_eval=self.evaluation is not None,
+        )
+        self.server = MasterServer(self.servicer, port=port)
+        # Workers learn the master address through the config bus.
+        config.master_addr = self.server.address
+
+        # -- worker fleet --
+        self.pod_manager = PodManager(
+            pod_backend if pod_backend is not None else ProcessPodBackend(),
+            config,
+        )
+        self.pod_manager.add_listener(self._on_pod_event)
+
+    # Pod death cascades: membership bump -> servicer listener requeues tasks.
+    def _on_pod_event(self, pod_name: str, phase: str) -> None:
+        if phase in (PodPhase.FAILED, PodPhase.DELETED, PodPhase.SUCCEEDED):
+            self.rendezvous.remove(pod_name)
+
+    def scale(self, n: int) -> None:
+        """Elastic resize (the 4->8->4 path): grow/shrink the worker fleet."""
+        self.pod_manager.scale(n)
+
+    def run(self, poll_interval_s: float = 0.2, reap_every_s: float = 5.0) -> Dict:
+        """Supervise the job to completion; returns the final job status."""
+        self.server.start()
+        self.pod_manager.start()
+        last_reap = time.monotonic()
+        try:
+            while not self.servicer.job_finished():
+                now = time.monotonic()
+                if now - last_reap >= reap_every_s:
+                    dead = self.rendezvous.reap_dead()
+                    if dead:
+                        logger.warning("reaped stale workers: %s", dead)
+                    last_reap = now
+                if self.pod_manager.all_finished() and self.pod_manager.desired() > 0:
+                    # Whole fleet exited (relaunch budgets burned) with work
+                    # left: fail the job instead of spinning forever.
+                    if not self.servicer.job_finished():
+                        raise RuntimeError(
+                            "all worker pods terminated before the job finished"
+                        )
+                time.sleep(poll_interval_s)
+            status = self.servicer.JobStatus({})
+            logger.info("job finished: %s", status)
+            return status
+        finally:
+            self.shutdown()
+
+    def shutdown(self) -> None:
+        self.pod_manager.stop()
+        self.server.stop()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    try:
+        config = JobConfig.from_env()
+    except KeyError:
+        config = parse_args(argv)
+    master = Master(config)
+    status = master.run()
+    return 0 if not status.get("abandoned") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

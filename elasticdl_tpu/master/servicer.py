@@ -47,6 +47,7 @@ class MasterServicer:
         dispatcher: TaskDispatcher,
         rendezvous: Optional[RendezvousServer] = None,
         evaluation: Optional[EvaluationService] = None,
+        final_eval: bool = False,
     ):
         self.dispatcher = dispatcher
         self.rendezvous = rendezvous or RendezvousServer()
@@ -54,6 +55,15 @@ class MasterServicer:
         self._lock = threading.Lock()
         self._model_version = 0
         self._checkpoint: Dict[str, object] = {"path": None, "step": 0}
+        # final_eval: run one last eval round after the training tasks drain,
+        # BEFORE reporting the job finished (the reference's end-of-job eval).
+        # Triggered inside GetTask so workers can't race past the job end.
+        # A shard-less eval service could never satisfy the trigger, so it
+        # must not hold the job open.
+        self._final_eval = (
+            final_eval and evaluation is not None and evaluation.enabled()
+        )
+        self._final_eval_done = False
         # A dead worker's tasks must be requeued in BOTH dispatchers.
         self.rendezvous.add_listener(self._on_membership_change)
         self._known_workers: set = set()
@@ -82,13 +92,39 @@ class MasterServicer:
         # model version quickly (reference behavior: eval tasks share the queue
         # with priority).
         if self.evaluation is not None:
+            if (
+                self._final_eval
+                and not self._final_eval_done
+                and self.dispatcher.finished()
+            ):
+                # The flag is only set once trigger() actually starts the
+                # round; a False return (periodic round still in flight)
+                # leaves it unset, so job_finished() stays False and the
+                # final round is retried on a later GetTask.  The lock
+                # serializes concurrent GetTask callers.
+                with self._lock:
+                    version = self._model_version
+                    if not self._final_eval_done and self.evaluation.trigger(
+                        version
+                    ):
+                        self._final_eval_done = True
             task = self.evaluation.get_task(worker_id)
             if task is not None:
                 return {"task": task.to_dict(), "finished": False}
         task = self.dispatcher.get_task(worker_id)
         if task is None:
-            return {"task": None, "finished": self.dispatcher.finished()}
+            return {"task": None, "finished": self.job_finished()}
         return {"task": task.to_dict(), "finished": False}
+
+    def job_finished(self) -> bool:
+        """True when training tasks drained AND any pending/in-flight eval is done."""
+        if not self.dispatcher.finished():
+            return False
+        if self.evaluation is None:
+            return True
+        if self._final_eval and not self._final_eval_done:
+            return False
+        return not self.evaluation.round_in_flight()
 
     def ReportTaskResult(self, req: dict) -> dict:
         task_id = int(req["task_id"])

@@ -54,7 +54,13 @@ def _load() -> ctypes.CDLL:
                 _LIB_PATH
             ) < os.path.getmtime(src):
                 _build()
-            lib = ctypes.CDLL(_LIB_PATH)
+            try:
+                lib = ctypes.CDLL(_LIB_PATH)
+            except OSError:
+                # A stale/foreign-arch binary that is newer than the source
+                # still can't load — rebuild once from source and retry.
+                _build()
+                lib = ctypes.CDLL(_LIB_PATH)
         except (subprocess.CalledProcessError, OSError) as e:
             _lib_error = f"native lib unavailable: {e}"
             raise RuntimeError(_lib_error) from e
@@ -163,11 +169,16 @@ class HostEmbeddingStore:
             pass
 
 
-def recordio_index_native(path: str, max_records: int = 1 << 24) -> np.ndarray:
+def recordio_index_native(path: str) -> np.ndarray:
     """Native recordio offset scan (data/recordio.py's fast path)."""
     lib = _load()
+    # Every record costs at least its 8-byte header, so file_size/8 bounds the
+    # record count exactly — no fixed cap, no oversized allocation.
+    max_records = max(os.path.getsize(path) // 8, 1)
     offsets = np.empty((max_records,), np.int64)
     n = int(lib.edl_recordio_index(path.encode(), offsets, max_records))
+    if n == -2:
+        raise IOError(f"{path}: more records than the size bound allows")
     if n < 0:
         raise IOError(f"{path}: malformed recordio")
     return offsets[:n].copy()

@@ -182,25 +182,29 @@ void edl_store_push_grad(EdlStore* s, const int64_t* ids, int64_t n,
 
 // Checkpoint: [int64 n][int64 dim][int64 stride][int32 opt]
 //             then per row: [int64 id][int32 adam_t][stride floats]
+// Every write is checked: a short write (full disk, I/O error) must fail the
+// save, not surface later as an unreadable checkpoint.
 int64_t edl_store_save(EdlStore* s, const char* path) {
   FILE* f = std::fopen(path, "wb");
   if (!f) return -1;
+  bool ok = true;
   int64_t n = (int64_t)s->index.size();
-  std::fwrite(&n, 8, 1, f);
-  std::fwrite(&s->dim, 8, 1, f);
-  std::fwrite(&s->stride, 8, 1, f);
+  ok &= std::fwrite(&n, 8, 1, f) == 1;
+  ok &= std::fwrite(&s->dim, 8, 1, f) == 1;
+  ok &= std::fwrite(&s->stride, 8, 1, f) == 1;
   int32_t opt = s->opt;
-  std::fwrite(&opt, 4, 1, f);
-  for (int64_t i = 0; i < n; i++) {
+  ok &= std::fwrite(&opt, 4, 1, f) == 1;
+  for (int64_t i = 0; ok && i < n; i++) {
     int64_t id = s->ids_in_order[i];
     int64_t off = s->index[id];
     int32_t t = (s->opt == OPT_ADAM) ? s->adam_t[off / s->stride] : 0;
-    std::fwrite(&id, 8, 1, f);
-    std::fwrite(&t, 4, 1, f);
-    std::fwrite(s->arena.data() + off, sizeof(float), s->stride, f);
+    ok &= std::fwrite(&id, 8, 1, f) == 1;
+    ok &= std::fwrite(&t, 4, 1, f) == 1;
+    ok &= std::fwrite(s->arena.data() + off, sizeof(float), s->stride, f) ==
+          (size_t)s->stride;
   }
-  std::fclose(f);
-  return n;
+  ok &= std::fclose(f) == 0;
+  return ok ? n : -1;
 }
 
 int64_t edl_store_load(EdlStore* s, const char* path) {
@@ -247,8 +251,10 @@ int64_t edl_store_load(EdlStore* s, const char* path) {
 // --------------------------------------------------------- recordio scanner
 
 // Scan an EDLRIO file, filling offsets[] (record byte offsets) up to
-// max_records.  Returns the number of records found, or -1 on malformed
-// input.  Mirrors data/recordio.py (the format's source of truth).
+// max_records.  Returns the number of records found, -1 on malformed input,
+// or -2 if the file holds more than max_records records (truncation is an
+// error, never silent).  Mirrors data/recordio.py (the format's source of
+// truth).
 int64_t edl_recordio_index(const char* path, int64_t* offsets,
                            int64_t max_records) {
   FILE* f = std::fopen(path, "rb");
@@ -270,7 +276,9 @@ int64_t edl_recordio_index(const char* path, int64_t* offsets,
     pos += 8 + (int64_t)hdr[0];
   }
   std::fclose(f);
-  return (pos > size) ? -1 : n;
+  if (pos > size) return -1;
+  if (pos < size) return -2;  // records remain beyond max_records
+  return n;
 }
 
 // CRC-verify records [start, end) given their offsets; returns the index of

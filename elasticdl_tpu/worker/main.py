@@ -1,0 +1,67 @@
+"""Worker pod entry point.
+
+Reference parity (SURVEY.md §2 #7 [U]): the master renders worker pods whose
+command is the worker main module and whose args/env carry the job config;
+here the config bus is the ``ELASTICDL_JOB_CONFIG`` env var (set by the
+PodManager) with CLI flags as a fallback, and the worker id comes from
+``ELASTICDL_WORKER_ID`` (the pod name).
+
+Run as ``python -m elasticdl_tpu.worker.main``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List, Optional
+
+from elasticdl_tpu.common.config import JobConfig, parse_args
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.data.reader import (
+    AbstractDataReader,
+    CompositeDataReader,
+    create_data_reader,
+)
+from elasticdl_tpu.worker.worker import RpcMasterProxy, Worker
+
+logger = get_logger("worker.main")
+
+
+def build_job_reader(config: JobConfig) -> AbstractDataReader:
+    """One reader serving every dataset the job's tasks may name."""
+    params = config.parsed_data_reader_params()
+    paths = [
+        p
+        for p in (
+            config.training_data,
+            config.validation_data,
+            config.prediction_data,
+        )
+        if p
+    ]
+    if not paths:
+        raise ValueError("job config names no data paths")
+    readers = [create_data_reader(p, params) for p in dict.fromkeys(paths)]
+    return readers[0] if len(readers) == 1 else CompositeDataReader(readers)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    try:
+        config = JobConfig.from_env()
+    except KeyError:
+        config = parse_args(argv)
+    if not config.master_addr:
+        raise SystemExit("worker needs --master_addr (or config via env)")
+    worker_id = os.environ.get("ELASTICDL_WORKER_ID", f"worker-{os.getpid()}")
+
+    master = RpcMasterProxy(config.master_addr)
+    worker = Worker(
+        config, master, build_job_reader(config), worker_id=worker_id
+    )
+    result = worker.run()
+    logger.info("worker %s finished: %s", worker_id, result)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
