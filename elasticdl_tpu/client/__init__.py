@@ -1,0 +1,38 @@
+"""Client layer — the ``elasticdl`` command.
+
+Reference parity (SURVEY.md §2 #1 [U — mount empty at survey time; the
+``elasticdl`` CLI name and its train/evaluate/predict + zoo verbs are [D]
+via BASELINE.json): the reference's ``elasticdl_client`` package is the
+user-facing console command that bakes model-zoo docker images
+(``zoo init/build/push``) and submits jobs (``train/evaluate/predict``) by
+rendering a master pod spec and creating it through the Kubernetes API.
+
+TPU rebuild: same verbs, two deployment modes:
+
+- **local** (default when no cluster flags given): run the master
+  in-process; workers are subprocesses via ``ProcessPodBackend``.  This is
+  also the single-host TPU mode — one v5e host drives all its chips.
+- **cluster**: render the master pod manifest (GKE TPU node-pool selectors,
+  ``google.com/tpu`` resources) and submit it with the kubernetes client if
+  installed, else write the manifest for ``kubectl apply``.
+"""
+
+from elasticdl_tpu.client.api import (
+    evaluate,
+    predict,
+    render_master_pod_manifest,
+    submit,
+    train,
+)
+from elasticdl_tpu.client.zoo import zoo_build, zoo_init, zoo_push
+
+__all__ = [
+    "train",
+    "evaluate",
+    "predict",
+    "submit",
+    "render_master_pod_manifest",
+    "zoo_init",
+    "zoo_build",
+    "zoo_push",
+]

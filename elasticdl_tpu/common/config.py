@@ -73,6 +73,16 @@ class JobConfig:
     num_workers: int = 1
     num_ps_shards: int = 0  # 0 = shard embeddings over all mesh devices
     use_tpu: bool = True
+    # How the master launches workers: "process" (local subprocesses),
+    # "kubernetes" (GKE TPU pods), or "fake" (tests).  The reference's
+    # equivalent choice is implicit in running on k8s at all.
+    pod_backend: str = "process"
+    worker_image: str = "elasticdl-tpu:latest"  # pod image (kubernetes backend)
+    namespace: str = "default"
+    # Host workers use to reach the master service.  Empty = auto: localhost
+    # for local backends, this pod's IP (MY_POD_IP downward API) or FQDN for
+    # the kubernetes backend.
+    master_advertise_host: str = ""
 
     # --- elasticity ---
     relaunch_on_worker_failure: bool = True
@@ -106,6 +116,11 @@ class JobConfig:
             raise ValueError("--num_minibatches_per_task must be positive")
         if self.job_type not in ("training", "evaluation", "prediction"):
             raise ValueError(f"unknown job_type {self.job_type!r}")
+        if self.pod_backend not in ("process", "kubernetes", "fake"):
+            raise ValueError(
+                f"--pod_backend must be process|kubernetes|fake, got "
+                f"{self.pod_backend!r}"
+            )
 
     # -- serialization: the config bus between master and worker pods --
 

@@ -23,9 +23,15 @@ from elasticdl_tpu.master.task_dispatcher import (
 
 
 class EvaluationService:
-    def __init__(self, eval_shards: List[Shard], evaluation_steps: int = 0):
+    def __init__(
+        self,
+        eval_shards: List[Shard],
+        evaluation_steps: int = 0,
+        task_timeout_s: float = 600.0,
+    ):
         self._shards = list(eval_shards)
         self._every = evaluation_steps
+        self._task_timeout_s = task_timeout_s
         self._lock = threading.Lock()
         self._dispatcher: Optional[TaskDispatcher] = None
         self._last_triggered_version = 0
@@ -61,7 +67,10 @@ class EvaluationService:
 
     def _start_round_locked(self, model_version: int) -> None:
         self._dispatcher = TaskDispatcher(
-            self._shards, num_epochs=1, task_type=TASK_EVALUATION
+            self._shards,
+            num_epochs=1,
+            task_type=TASK_EVALUATION,
+            task_timeout_s=self._task_timeout_s,
         )
         self._last_triggered_version = model_version
         self._sums, self._counts = {}, {}

@@ -15,6 +15,7 @@ predict subcommands spawn exactly this), or embed via ``Master`` for tests.
 
 from __future__ import annotations
 
+import os
 import sys
 import time
 from typing import Dict, List, Optional
@@ -83,6 +84,7 @@ class Master:
             self.evaluation = EvaluationService(
                 eval_reader.create_shards(records_per_task),
                 evaluation_steps=config.evaluation_steps,
+                task_timeout_s=config.task_timeout_s,
             )
 
         # -- control plane --
@@ -95,16 +97,45 @@ class Master:
             evaluation=self.evaluation,
             final_eval=self.evaluation is not None,
         )
-        self.server = MasterServer(self.servicer, port=port)
+        self.server = MasterServer(
+            self.servicer, port=port, advertise_host=self._advertise_host(config)
+        )
         # Workers learn the master address through the config bus.
         config.master_addr = self.server.address
 
         # -- worker fleet --
         self.pod_manager = PodManager(
-            pod_backend if pod_backend is not None else ProcessPodBackend(),
+            pod_backend if pod_backend is not None else self._build_backend(config),
             config,
         )
         self.pod_manager.add_listener(self._on_pod_event)
+
+    @staticmethod
+    def _advertise_host(config: JobConfig) -> str:
+        """The address workers dial.  Cross-pod backends need a reachable
+        host: the pod IP via the downward API (``MY_POD_IP``) or this host's
+        FQDN; local backends keep localhost."""
+        if config.master_advertise_host:
+            return config.master_advertise_host
+        if config.pod_backend == "kubernetes":
+            import socket
+
+            return os.environ.get("MY_POD_IP") or socket.getfqdn()
+        return "localhost"
+
+    @staticmethod
+    def _build_backend(config: JobConfig) -> PodBackend:
+        if config.pod_backend == "kubernetes":
+            from elasticdl_tpu.master.pod_manager import KubernetesPodBackend
+
+            return KubernetesPodBackend(
+                config, namespace=config.namespace, image=config.worker_image
+            )
+        if config.pod_backend == "fake":
+            from elasticdl_tpu.master.pod_manager import FakePodBackend
+
+            return FakePodBackend()
+        return ProcessPodBackend()
 
     # Pod death cascades: membership bump -> servicer listener requeues tasks.
     def _on_pod_event(self, pod_name: str, phase: str) -> None:

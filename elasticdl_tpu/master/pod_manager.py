@@ -171,18 +171,24 @@ class ProcessPodBackend(PodBackend):
 
     def _watch(self) -> None:
         while not self._stop.is_set():
-            done = []
-            with self._lock:
-                for name, proc in self._procs.items():
-                    rc = proc.poll()
-                    if rc is not None:
-                        done.append((name, rc))
-                for name, _ in done:
-                    del self._procs[name]
-            for name, rc in done:
-                self._emit(
-                    name, PodPhase.SUCCEEDED if rc == 0 else PodPhase.FAILED
-                )
+            try:
+                done = []
+                with self._lock:
+                    for name, proc in self._procs.items():
+                        rc = proc.poll()
+                        if rc is not None:
+                            done.append((name, rc))
+                    for name, _ in done:
+                        del self._procs[name]
+                for name, rc in done:
+                    self._emit(
+                        name,
+                        PodPhase.SUCCEEDED if rc == 0 else PodPhase.FAILED,
+                    )
+            except Exception:
+                # The watcher is the only observer of worker exits; it must
+                # survive any emit-chain error or elasticity silently dies.
+                logger.exception("pod watcher iteration failed")
             time.sleep(self._poll)
 
     def pid(self, name: str) -> Optional[int]:
@@ -292,14 +298,21 @@ class KubernetesPodBackend(PodBackend):
         watch = kubernetes.watch.Watch()
         selector = f"elasticdl-job-name={self._config.job_name}"
         while not self._stop.is_set():
-            for event in watch.stream(
-                self._core.list_namespaced_pod,
-                self._ns,
-                label_selector=selector,
-                timeout_seconds=30,
-            ):
-                pod = event["object"]
-                self._emit(pod.metadata.name, pod.status.phase)
+            try:
+                for event in watch.stream(
+                    self._core.list_namespaced_pod,
+                    self._ns,
+                    label_selector=selector,
+                    timeout_seconds=30,
+                ):
+                    pod = event["object"]
+                    self._emit(pod.metadata.name, pod.status.phase)
+            except Exception:
+                # watch.stream raises routinely (410 Gone on resourceVersion
+                # expiry, transient apiserver errors); re-establish the watch
+                # instead of letting the thread die.
+                logger.exception("k8s watch stream failed; re-watching")
+                time.sleep(1.0)
 
     def close(self) -> None:  # pragma: no cover
         self._stop.set()
@@ -347,7 +360,11 @@ class PodManager:
 
     def _notify(self, name: str, phase: str) -> None:
         for fn in self._listeners:
-            fn(name, phase)
+            try:
+                fn(name, phase)
+            except Exception:
+                # Listeners run on backend watcher threads; see _on_event.
+                logger.exception("pod listener failed for %s/%s", name, phase)
 
     # -- fleet control --
 
@@ -444,9 +461,20 @@ class PodManager:
                 name, relaunch_info.name,
                 relaunch_info.relaunches, self._max_relaunch,
             )
-            self._backend.start_pod(
-                relaunch_info.name, self._pod_env(relaunch_info)
-            )
+            try:
+                self._backend.start_pod(
+                    relaunch_info.name, self._pod_env(relaunch_info)
+                )
+            except Exception:
+                # A failed relaunch (OSError under memory pressure, k8s API
+                # error, ...) must not unwind into the backend's watcher
+                # thread — that would kill the only thread observing pod
+                # events and freeze elasticity.  Retire the slot instead.
+                logger.exception("relaunch of %s failed", relaunch_info.name)
+                with self._lock:
+                    if self._slots.get(relaunch_info.slot) is relaunch_info:
+                        self._slots[relaunch_info.slot] = None
+                self._notify(relaunch_info.name, PodPhase.FAILED)
 
     # -- introspection --
 

@@ -210,17 +210,27 @@ class MasterServicer:
 class MasterServer:
     """gRPC server hosting a MasterServicer on ``port`` (0 = ephemeral)."""
 
-    def __init__(self, servicer: MasterServicer, port: int = 0, max_workers: int = 32):
+    def __init__(
+        self,
+        servicer: MasterServicer,
+        port: int = 0,
+        max_workers: int = 32,
+        advertise_host: str = "localhost",
+    ):
         self.servicer = servicer
         self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
         self._server.add_generic_rpc_handlers(
             (make_generic_handler(SERVICE_NAME, servicer.method_table()),)
         )
         self.port = self._server.add_insecure_port(f"[::]:{port}")
+        # The host workers dial; for cluster deployments this must be a
+        # cross-pod-reachable address (pod IP / headless-service name), not
+        # localhost — see Master._advertise_host.
+        self.advertise_host = advertise_host
 
     @property
     def address(self) -> str:
-        return f"localhost:{self.port}"
+        return f"{self.advertise_host}:{self.port}"
 
     def start(self) -> "MasterServer":
         self._server.start()

@@ -172,16 +172,23 @@ class HostEmbeddingStore:
 def recordio_index_native(path: str) -> np.ndarray:
     """Native recordio offset scan (data/recordio.py's fast path)."""
     lib = _load()
-    # Every record costs at least its 8-byte header, so file_size/8 bounds the
-    # record count exactly — no fixed cap, no oversized allocation.
-    max_records = max(os.path.getsize(path) // 8, 1)
-    offsets = np.empty((max_records,), np.int64)
-    n = int(lib.edl_recordio_index(path.encode(), offsets, max_records))
-    if n == -2:
-        raise IOError(f"{path}: more records than the size bound allows")
-    if n < 0:
-        raise IOError(f"{path}: malformed recordio")
-    return offsets[:n].copy()
+    # Every record costs at least its 8-byte header, so file_size/8 is a hard
+    # bound on the record count — but allocating that many int64s up front
+    # would cost as much memory as the file itself.  Start from a typical
+    # record-count guess and grow on the scanner's -2 (capacity) signal.
+    hard_bound = max(os.path.getsize(path) // 8, 1)
+    cap = min(hard_bound, 1 << 20)
+    while True:
+        offsets = np.empty((cap,), np.int64)
+        n = int(lib.edl_recordio_index(path.encode(), offsets, cap))
+        if n == -2:
+            if cap >= hard_bound:
+                raise IOError(f"{path}: more records than the size bound allows")
+            cap = min(cap * 16, hard_bound)
+            continue
+        if n < 0:
+            raise IOError(f"{path}: malformed recordio")
+        return offsets[:n].copy()
 
 
 def recordio_verify_native(path: str, offsets: np.ndarray, start: int, end: int) -> int:

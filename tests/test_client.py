@@ -1,0 +1,127 @@
+"""CLI client: verb dispatch, manifest rendering, zoo init/build, and a full
+`elasticdl train --local`-equivalent job through the client API (the
+reference's client->master submission path, SURVEY.md §3.1, run in-process)."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from elasticdl_tpu.client import api, zoo
+from elasticdl_tpu.client.main import main as cli_main
+from elasticdl_tpu.common.config import JobConfig
+from elasticdl_tpu.data.synthetic import generate
+
+
+def test_cli_usage_and_unknown_verb():
+    assert cli_main([]) == 2
+    assert cli_main(["frobnicate"]) == 2
+    assert cli_main(["--help"]) == 0
+
+
+def test_master_manifest_render():
+    config = JobConfig(job_name="j1", training_data="/data/x.rio")
+    m = api.render_master_pod_manifest(config, image="zoo:v2")
+    assert m["metadata"]["name"] == "j1-master"
+    container = m["spec"]["containers"][0]
+    assert container["image"] == "zoo:v2"
+    assert container["command"] == ["python", "-m", "elasticdl_tpu.master.main"]
+    env = {e["name"]: e["value"] for e in container["env"]}
+    roundtrip = JobConfig.from_json(env["ELASTICDL_JOB_CONFIG"])
+    assert roundtrip.job_name == "j1"
+    assert roundtrip.training_data == "/data/x.rio"
+
+
+def test_submit_writes_manifest(tmp_path):
+    out = str(tmp_path / "master.json")
+    config = JobConfig(job_name="j2", training_data="/data/x.rio")
+    api.submit(config, manifest_out=out)
+    with open(out) as f:
+        manifest = json.load(f)
+    assert manifest["metadata"]["labels"]["elasticdl-replica-type"] == "master"
+
+
+def test_cli_train_manifest_out(tmp_path):
+    out = str(tmp_path / "m.json")
+    rc = cli_main(
+        [
+            "train",
+            "--job_name=cli-job",
+            "--training_data=/data/t.rio",
+            f"--manifest_out={out}",
+        ]
+    )
+    assert rc == 0
+    with open(out) as f:
+        manifest = json.load(f)
+    env = {
+        e["name"]: e["value"]
+        for e in manifest["spec"]["containers"][0]["env"]
+    }
+    cfg = JobConfig.from_json(env["ELASTICDL_JOB_CONFIG"])
+    assert cfg.job_type == "training"
+    assert cfg.job_name == "cli-job"
+
+
+def test_zoo_init_build_cycle(tmp_path):
+    zoo_dir = str(tmp_path / "myzoo")
+    zoo.zoo_init(zoo_dir)
+    specs = zoo.discover_model_specs(zoo_dir)
+    assert any("template" in k for k in specs)
+    assert zoo.zoo_build(zoo_dir, validate_only=True) == 0
+    # init is idempotent: re-running keeps existing files
+    zoo.zoo_init(zoo_dir)
+
+
+def test_zoo_build_reports_bad_model(tmp_path):
+    zoo_dir = tmp_path / "badzoo"
+    zoo_dir.mkdir()
+    (zoo_dir / "__init__.py").write_text("")
+    (zoo_dir / "broken.py").write_text(
+        "def model_spec():\n    return object()\n"
+    )
+    assert zoo.zoo_build(str(zoo_dir), validate_only=True) == 1
+
+
+def test_zoo_build_empty_dir(tmp_path):
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert zoo.zoo_build(str(empty), validate_only=True) == 1
+
+
+@pytest.mark.slow
+def test_cli_local_train_job(tmp_path):
+    """`elasticdl train` local mode end-to-end: client -> in-process master ->
+    subprocess worker pods (the whole stack, one host)."""
+    train_path = str(tmp_path / "train.rio")
+    generate("mnist", train_path, 64)
+    ckpt = str(tmp_path / "ckpt")
+    rc = cli_main(
+        [
+            "train",
+            "--local",
+            "--job_name=cli-local",
+            "--model_def=mnist.model_spec",
+            "--model_params=compute_dtype=float32",
+            f"--training_data={train_path}",
+            "--minibatch_size=16",
+            "--num_minibatches_per_task=2",
+            "--num_workers=1",
+            f"--checkpoint_dir={ckpt}",
+            "--checkpoint_steps=2",
+        ]
+    )
+    assert rc == 0
+
+
+def test_console_script_entry():
+    """python -m elasticdl_tpu.client.main prints usage without a cluster."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "elasticdl_tpu.client.main", "--help"],
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert proc.returncode == 0
+    assert "train|evaluate|predict" in proc.stderr
