@@ -31,37 +31,24 @@ def render_master_pod_manifest(
     creates the TPU worker pods itself (see
     ``master.pod_manager.render_worker_pod_manifest``).
     """
+    from elasticdl_tpu.master.pod_manager import render_base_pod_manifest
+
     env = dict(config.to_env())
     env.update(extra_env or {})
-    return {
-        "apiVersion": "v1",
-        "kind": "Pod",
-        "metadata": {
-            "name": f"{config.job_name}-master",
-            "labels": {
-                "app": "elasticdl-tpu",
-                "elasticdl-job-name": config.job_name,
-                "elasticdl-replica-type": "master",
-            },
-        },
-        "spec": {
-            "restartPolicy": "Never",
-            "serviceAccountName": "elasticdl-master",  # needs pod create/watch
-            "containers": [
-                {
-                    "name": "master",
-                    "image": image,
-                    "command": ["python", "-m", "elasticdl_tpu.master.main"],
-                    "env": [
-                        {"name": k, "value": v} for k, v in sorted(env.items())
-                    ],
-                    "resources": {
-                        "requests": {"cpu": "1", "memory": "2Gi"},
-                    },
-                }
-            ],
-        },
+    manifest = render_base_pod_manifest(
+        config.job_name,
+        f"{config.job_name}-master",
+        "master",
+        image,
+        ["python", "-m", "elasticdl_tpu.master.main"],
+        env,
+    )
+    # Control-plane only: no TPU, any CPU node; needs pod create/watch RBAC.
+    manifest["spec"]["serviceAccountName"] = "elasticdl-master"
+    manifest["spec"]["containers"][0]["resources"] = {
+        "requests": {"cpu": "1", "memory": "2Gi"},
     }
+    return manifest
 
 
 def submit(

@@ -116,32 +116,42 @@ def zoo_init(directory: str, base_image: str = "elasticdl-tpu:latest") -> None:
     logger.info("initialized model zoo %s (wrote %s)", directory, wrote)
 
 
-def discover_model_specs(directory: str) -> Dict[str, Callable[..., ModelSpec]]:
-    """Import every module in the zoo dir; collect ``*model_spec*`` callables."""
+def discover_model_specs(
+    directory: str,
+) -> Tuple[Dict[str, Callable[..., ModelSpec]], List[Tuple[str, str]]]:
+    """Import every module in the zoo dir; collect ``*model_spec*`` callables.
+
+    Returns (specs, import_failures) — a broken module (syntax error, missing
+    dependency) is reported per-module instead of aborting discovery.
+    """
     directory = os.path.abspath(directory)
     parent, pkg = os.path.split(directory)
     specs: Dict[str, Callable[..., ModelSpec]] = {}
+    failures: List[Tuple[str, str]] = []
     sys.path.insert(0, parent)
     try:
         for fname in sorted(os.listdir(directory)):
             if not fname.endswith(".py") or fname.startswith("_"):
                 continue
-            module = importlib.import_module(f"{pkg}.{fname[:-3]}")
+            try:
+                module = importlib.import_module(f"{pkg}.{fname[:-3]}")
+            except Exception as e:  # noqa: BLE001 - report, keep discovering
+                failures.append((fname, f"import failed: {e}"))
+                continue
             for attr in dir(module):
                 if "model_spec" in attr and callable(getattr(module, attr)):
                     specs[f"{fname[:-3]}.{attr}"] = getattr(module, attr)
     finally:
         sys.path.remove(parent)
-    return specs
+    return specs, failures
 
 
 def validate_zoo(directory: str) -> List[Tuple[str, str]]:
     """Build every spec and run a cheap abstract init; returns (name, error)s."""
     import jax
 
-    failures: List[Tuple[str, str]] = []
-    specs = discover_model_specs(directory)
-    if not specs:
+    specs, failures = discover_model_specs(directory)
+    if not specs and not failures:
         return [(directory, "no *model_spec* functions found")]
     for name, fn in specs.items():
         try:

@@ -11,14 +11,14 @@ shapes so a user can point the readers at actual MNIST/Criteo/Census dumps:
   allowed (missing values).
 - census: CSV — ``label,5 numerics,9 categorical strings``.
 
-String categoricals are mapped to stable int ids with crc32 on the host; the
-model re-buckets them on device (models/tabular.py), matching the reference's
-Hashing-preprocessing-then-Embedding pipeline.
+String categoricals are mapped to stable int ids host-side by the
+preprocessing Hashing layer (32-bit FNV-1a, elasticdl_tpu/preprocessing);
+the model re-buckets them on device (models/tabular.py), matching the
+reference's Hashing-preprocessing-then-Embedding pipeline.
 """
 
 from __future__ import annotations
 
-import zlib
 from typing import Sequence
 
 import numpy as np
@@ -90,16 +90,25 @@ def encode_census_example(
 
 
 def census_feed(records: Sequence[bytes]) -> dict:
+    """Census CSV -> batch, via the preprocessing layers (the reference feeds
+    census through elasticdl_preprocessing hashing/number layers the same
+    way; SURVEY.md §2 #15).  String categoricals are hashed host-side into a
+    31-bit id space; the model re-buckets them on device."""
+    from elasticdl_tpu.preprocessing import Hashing, ToNumber
+
+    to_number = ToNumber(out_dtype="float32", default=0.0)
+    hashing = Hashing(1 << 31)
     n = len(records)
-    dense = np.zeros((n, _CENSUS_DENSE), np.float32)
-    cat = np.zeros((n, _CENSUS_CAT), np.int32)
+    dense_raw = np.empty((n, _CENSUS_DENSE), object)
+    cat_raw = np.empty((n, _CENSUS_CAT), object)
     labels = np.zeros((n,), np.int32)
     for i, rec in enumerate(records):
         parts = rec.decode().split(",")
         labels[i] = int(parts[0])
-        dense[i] = [float(v) if v else 0.0 for v in parts[1 : 1 + _CENSUS_DENSE]]
-        cat[i] = [
-            np.int32(zlib.crc32(v.strip().encode()) & 0x7FFFFFFF)
-            for v in parts[1 + _CENSUS_DENSE :]
-        ]
-    return {"dense": dense, "cat": cat, "labels": labels}
+        dense_raw[i] = parts[1 : 1 + _CENSUS_DENSE]
+        cat_raw[i] = [v.strip() for v in parts[1 + _CENSUS_DENSE :]]
+    return {
+        "dense": to_number(dense_raw),
+        "cat": hashing(cat_raw).astype(np.int32),
+        "labels": labels,
+    }

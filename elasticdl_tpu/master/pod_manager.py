@@ -206,6 +206,51 @@ class ProcessPodBackend(PodBackend):
                 proc.kill()
 
 
+def render_base_pod_manifest(
+    job_name: str,
+    pod_name: str,
+    replica_type: str,
+    image: str,
+    command: List[str],
+    env: Dict[str, str],
+) -> dict:
+    """Common V1Pod scaffold for master and worker pods (labels, restart
+    policy, env plumbing).  Always injects ``MY_POD_IP`` via the downward
+    API: the master advertises it to workers (Master._advertise_host), and
+    having it everywhere keeps the two renderers from drifting."""
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": pod_name,
+            "labels": {
+                "app": "elasticdl-tpu",
+                "elasticdl-job-name": job_name,
+                "elasticdl-replica-type": replica_type,
+            },
+        },
+        "spec": {
+            "restartPolicy": "Never",  # relaunch policy lives in PodManager
+            "containers": [
+                {
+                    "name": replica_type,
+                    "image": image,
+                    "command": command,
+                    "env": [
+                        {
+                            "name": "MY_POD_IP",
+                            "valueFrom": {
+                                "fieldRef": {"fieldPath": "status.podIP"}
+                            },
+                        }
+                    ]
+                    + [{"name": k, "value": v} for k, v in sorted(env.items())],
+                }
+            ],
+        },
+    }
+
+
 def render_worker_pod_manifest(
     config: JobConfig,
     pod_name: str,
@@ -221,39 +266,23 @@ def render_worker_pod_manifest(
     retargeted at GKE TPU node pools: the ``google.com/tpu`` resource plus the
     podslice node selectors replace the reference's GPU resource requests.
     """
-    return {
-        "apiVersion": "v1",
-        "kind": "Pod",
-        "metadata": {
-            "name": pod_name,
-            "labels": {
-                "app": "elasticdl-tpu",
-                "elasticdl-job-name": config.job_name,
-                "elasticdl-replica-type": "worker",
-            },
-        },
-        "spec": {
-            "restartPolicy": "Never",  # the PodManager owns relaunch policy
-            "nodeSelector": {
-                "cloud.google.com/gke-tpu-accelerator": tpu_accelerator,
-                "cloud.google.com/gke-tpu-topology": tpu_topology,
-            },
-            "containers": [
-                {
-                    "name": "worker",
-                    "image": image,
-                    "command": ["python", "-m", "elasticdl_tpu.worker.main"],
-                    "env": [
-                        {"name": k, "value": v} for k, v in sorted(env.items())
-                    ],
-                    "resources": {
-                        "requests": {"google.com/tpu": str(tpu_chips_per_host)},
-                        "limits": {"google.com/tpu": str(tpu_chips_per_host)},
-                    },
-                }
-            ],
-        },
+    manifest = render_base_pod_manifest(
+        config.job_name,
+        pod_name,
+        "worker",
+        image,
+        ["python", "-m", "elasticdl_tpu.worker.main"],
+        env,
+    )
+    manifest["spec"]["nodeSelector"] = {
+        "cloud.google.com/gke-tpu-accelerator": tpu_accelerator,
+        "cloud.google.com/gke-tpu-topology": tpu_topology,
     }
+    manifest["spec"]["containers"][0]["resources"] = {
+        "requests": {"google.com/tpu": str(tpu_chips_per_host)},
+        "limits": {"google.com/tpu": str(tpu_chips_per_host)},
+    }
+    return manifest
 
 
 class KubernetesPodBackend(PodBackend):
@@ -466,15 +495,15 @@ class PodManager:
                     relaunch_info.name, self._pod_env(relaunch_info)
                 )
             except Exception:
-                # A failed relaunch (OSError under memory pressure, k8s API
-                # error, ...) must not unwind into the backend's watcher
-                # thread — that would kill the only thread observing pod
-                # events and freeze elasticity.  Retire the slot instead.
+                # A failed relaunch (OSError under memory pressure, transient
+                # k8s API error, ...) must not unwind into the backend's
+                # watcher thread — that would kill the only thread observing
+                # pod events and freeze elasticity.  Treat it as an immediate
+                # pod failure instead: the normal FAILED path re-relaunches
+                # while this slot's budget lasts (bounded recursion), then
+                # retires the slot with a warning.
                 logger.exception("relaunch of %s failed", relaunch_info.name)
-                with self._lock:
-                    if self._slots.get(relaunch_info.slot) is relaunch_info:
-                        self._slots[relaunch_info.slot] = None
-                self._notify(relaunch_info.name, PodPhase.FAILED)
+                self._on_event(relaunch_info.name, PodPhase.FAILED)
 
     # -- introspection --
 

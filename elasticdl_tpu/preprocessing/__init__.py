@@ -1,0 +1,37 @@
+"""Feature preprocessing layers.
+
+Reference parity (SURVEY.md §2 #15 [U — mount empty at survey time]): the
+reference ships ``elasticdl_preprocessing/`` — Keras layers (Hashing,
+IndexLookup, Normalizer, Discretization, RoundIdentity, ToNumber,
+ConcatenateWithOffset) replacing ``tf.feature_column`` for its tabular
+models (census Wide&Deep, Criteo DeepFM).
+
+TPU rebuild: each layer is a small stateful-at-fit-time / pure-at-call-time
+object.  ``adapt()`` (vocab building, moment accumulation, quantile
+boundaries) runs host-side over numpy record batches — that's feed-stage
+work, off the accelerator, exactly where the reference runs it too.
+``__call__`` is pure array math: on numpy inputs (inside ``ModelSpec.feed``)
+it stays on host; on jnp inputs it traces into the jitted step — no data-
+dependent shapes, so XLA compiles it once.  String hashing/lookup is
+host-only (strings can't cross into jit) and therefore belongs in ``feed``.
+"""
+
+from elasticdl_tpu.preprocessing.layers import (
+    ConcatenateWithOffset,
+    Discretization,
+    Hashing,
+    IndexLookup,
+    Normalizer,
+    RoundIdentity,
+    ToNumber,
+)
+
+__all__ = [
+    "Hashing",
+    "IndexLookup",
+    "Normalizer",
+    "Discretization",
+    "RoundIdentity",
+    "ToNumber",
+    "ConcatenateWithOffset",
+]

@@ -1,0 +1,435 @@
+"""Preprocessing layer implementations.
+
+See package docstring for the host/device split.  Every layer follows the
+same contract:
+
+- ``adapt(batches)`` — optional fit pass over an iterable of numpy arrays
+  (or one array); accumulates state incrementally so arbitrarily large
+  datasets stream through.
+- ``__call__(x)`` — pure transform.  Works on numpy arrays (host, feed
+  stage) and on jax arrays (traced into the jitted step) wherever dtypes
+  allow; string inputs are host-only.
+- ``get_config()/from_config`` — JSON-serializable state, so fitted
+  preprocessing ships to workers over the config bus like the reference
+  bakes it into the model image.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Union
+
+import numpy as np
+
+Array = Any  # numpy or jax array
+
+
+def _numpy_like(x: Array) -> bool:
+    return isinstance(x, np.ndarray) or np.isscalar(x) or isinstance(x, (list, tuple))
+
+
+def _xp(x: Array):
+    """The array namespace to compute in: numpy on host data, jnp under jit."""
+    if _numpy_like(x):
+        return np
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _batches(data: Union[Array, Iterable[Array]]) -> Iterable[np.ndarray]:
+    if isinstance(data, np.ndarray):
+        yield data
+        return
+    for batch in data:
+        yield np.asarray(batch)
+
+
+# 32-bit FNV-1a: deterministic across hosts/processes (unlike python's
+# salted hash()), cheap to vectorize in numpy, and — because jax disables
+# x64 by default — computable identically in jnp uint32 (multiplication is
+# mod 2^32 in both namespaces).  Integer ids hash by their low 32 bits:
+# embedding id spaces fit in 32 bits on TPU anyway, so nothing aliases.
+_FNV_OFFSET32 = 2166136261
+_FNV_PRIME32 = 16777619
+
+
+def _fnv1a_u32(data: np.ndarray) -> np.ndarray:
+    """Vectorized FNV-1a of each element's 4 low little-endian bytes."""
+    v = (data.astype(np.int64).astype(np.uint64) & np.uint64(0xFFFFFFFF)).astype(
+        np.uint32
+    )
+    h = np.full(v.shape, _FNV_OFFSET32, np.uint32)
+    with np.errstate(over="ignore"):
+        for shift in range(0, 32, 8):
+            h = (h ^ ((v >> np.uint32(shift)) & np.uint32(0xFF))) * np.uint32(
+                _FNV_PRIME32
+            )
+    return h
+
+
+def _fnv1a_bytes(s: bytes) -> int:
+    h = _FNV_OFFSET32
+    for b in s:
+        h = ((h ^ b) * _FNV_PRIME32) & 0xFFFFFFFF
+    return h
+
+
+class Hashing:
+    """Hash integer or string features into ``[0, num_bins)``.
+
+    The reference's Hashing layer wraps tf.strings.to_hash_bucket_fast; here
+    integers use a vectorized FNV-1a mix (stable across processes, so master
+    and every worker agree), strings hash host-side in ``feed``.  Integer
+    input under jit uses the same mix in jnp — identical results on host and
+    device.
+    """
+
+    def __init__(self, num_bins: int):
+        if num_bins <= 0:
+            raise ValueError("num_bins must be positive")
+        self.num_bins = num_bins
+
+    def __call__(self, x: Array) -> Array:
+        if _numpy_like(x):
+            arr = np.asarray(x)
+            if arr.dtype.kind in ("U", "S", "O"):
+                flat = np.array(
+                    [
+                        _fnv1a_bytes(
+                            s.encode() if isinstance(s, str) else bytes(s)
+                        )
+                        % self.num_bins
+                        for s in arr.ravel()
+                    ],
+                    np.int64,
+                )
+                return flat.reshape(arr.shape)
+            return (_fnv1a_u32(arr) % np.uint32(self.num_bins)).astype(np.int64)
+        import jax.numpy as jnp
+
+        v = x.astype(jnp.uint32)
+        h = jnp.full(v.shape, _FNV_OFFSET32, jnp.uint32)
+        for shift in range(0, 32, 8):
+            h = (h ^ ((v >> shift) & jnp.uint32(0xFF))) * jnp.uint32(_FNV_PRIME32)
+        return (h % jnp.uint32(self.num_bins)).astype(jnp.int32)
+
+    def get_config(self) -> Dict:
+        return {"num_bins": self.num_bins}
+
+    @classmethod
+    def from_config(cls, cfg: Dict) -> "Hashing":
+        return cls(**cfg)
+
+
+class IndexLookup:
+    """Map categorical values to dense indices via a fitted vocabulary.
+
+    Out-of-vocabulary values map to ``num_oov`` rolling buckets placed
+    *before* the vocab (index = hash % num_oov), as the reference's
+    IndexLookup does.  ``adapt`` builds the vocab by frequency; a fixed
+    vocabulary can be passed directly.  Host-side only for strings; fitted
+    integer vocabs also work under jit via sorted-array searchsorted.
+    """
+
+    def __init__(
+        self,
+        vocabulary: Optional[Sequence] = None,
+        num_oov: int = 1,
+        max_tokens: int = 0,
+    ):
+        if num_oov < 0:
+            raise ValueError("num_oov must be >= 0")
+        self.num_oov = num_oov
+        self.max_tokens = max_tokens
+        self._counts: Dict[Any, int] = {}
+        self.vocabulary: List = list(vocabulary) if vocabulary is not None else []
+        self._index: Dict[Any, int] = {}
+        self._reindex()
+
+    def _reindex(self) -> None:
+        self._index = {
+            tok: i + self.num_oov for i, tok in enumerate(self.vocabulary)
+        }
+        # Integer vocabs additionally support vectorized/jit lookup.
+        self._int_vocab: Optional[np.ndarray] = None
+        if self.vocabulary and all(
+            isinstance(t, (int, np.integer)) for t in self.vocabulary
+        ):
+            order = np.argsort(np.asarray(self.vocabulary, np.int64))
+            self._int_sorted = np.asarray(self.vocabulary, np.int64)[order]
+            self._int_rank = order.astype(np.int64)  # sorted pos -> vocab pos
+            self._int_vocab = self._int_sorted
+
+    def adapt(self, data: Union[Array, Iterable[Array]]) -> "IndexLookup":
+        for batch in _batches(data):
+            values, counts = np.unique(batch.ravel(), return_counts=True)
+            for v, c in zip(values.tolist(), counts.tolist()):
+                self._counts[v] = self._counts.get(v, 0) + c
+        ordered = sorted(self._counts.items(), key=lambda kv: (-kv[1], str(kv[0])))
+        if self.max_tokens:
+            ordered = ordered[: self.max_tokens]
+        self.vocabulary = [v for v, _ in ordered]
+        self._reindex()
+        return self
+
+    @property
+    def vocab_size(self) -> int:
+        """Total output index space (oov buckets + vocab)."""
+        return self.num_oov + len(self.vocabulary)
+
+    def _oov_index(self, value: Any) -> int:
+        if self.num_oov == 0:
+            raise KeyError(f"{value!r} not in vocabulary (num_oov=0)")
+        if isinstance(value, (int, np.integer)):
+            return int(_fnv1a_u32(np.asarray([value]))[0] % self.num_oov)
+        data = value.encode() if isinstance(value, str) else bytes(value)
+        return _fnv1a_bytes(data) % self.num_oov
+
+    def __call__(self, x: Array) -> Array:
+        if _numpy_like(x):
+            arr = np.asarray(x)
+            flat = np.array(
+                [
+                    self._index.get(v, None)
+                    if self._index.get(v, None) is not None
+                    else self._oov_index(v)
+                    for v in arr.ravel().tolist()
+                ],
+                np.int64,
+            )
+            return flat.reshape(arr.shape)
+        if self._int_vocab is None:
+            raise TypeError(
+                "IndexLookup under jit needs an integer vocabulary; "
+                "string lookup must run in feed (host)"
+            )
+        if self.num_oov == 0:
+            # The host path raises KeyError per OOV value; traced code can't
+            # branch on data, so a silent nearest-index result would map OOV
+            # features onto another token's embedding row.  Refuse instead.
+            raise ValueError(
+                "IndexLookup with num_oov=0 cannot run under jit (OOV inputs "
+                "would silently alias in-vocab indices); use num_oov >= 1"
+            )
+        import jax.numpy as jnp
+
+        sorted_vocab = jnp.asarray(self._int_sorted)
+        rank = jnp.asarray(self._int_rank)
+        pos = jnp.searchsorted(sorted_vocab, x)
+        pos_c = jnp.clip(pos, 0, len(self._int_sorted) - 1)
+        hit = sorted_vocab[pos_c] == x
+        in_vocab = rank[pos_c] + self.num_oov
+        oov = Hashing(self.num_oov)(x)
+        return jnp.where(hit, in_vocab, oov)
+
+    def get_config(self) -> Dict:
+        return {
+            "vocabulary": [
+                v.item() if isinstance(v, np.generic) else v
+                for v in self.vocabulary
+            ],
+            "num_oov": self.num_oov,
+            "max_tokens": self.max_tokens,
+        }
+
+    @classmethod
+    def from_config(cls, cfg: Dict) -> "IndexLookup":
+        return cls(**cfg)
+
+
+class Normalizer:
+    """Standardize numeric features with adapted mean/variance (Welford-style
+    streaming accumulation, so adapt() handles any dataset size)."""
+
+    def __init__(
+        self, mean: Optional[Array] = None, variance: Optional[Array] = None
+    ):
+        self.mean = None if mean is None else np.asarray(mean, np.float64)
+        self.variance = (
+            None if variance is None else np.asarray(variance, np.float64)
+        )
+        self._count = 0.0
+
+    def adapt(self, data: Union[Array, Iterable[Array]]) -> "Normalizer":
+        for batch in _batches(data):
+            b = batch.astype(np.float64)
+            b = b.reshape(-1, b.shape[-1]) if b.ndim > 1 else b.reshape(-1, 1)
+            n_b = b.shape[0]
+            mean_b = b.mean(0)
+            var_b = b.var(0)
+            if self._count == 0:
+                self.mean, self.variance, self._count = mean_b, var_b, n_b
+                continue
+            n = self._count + n_b
+            delta = mean_b - self.mean
+            self.variance = (
+                self._count * self.variance
+                + n_b * var_b
+                + (self._count * n_b / n) * delta**2
+            ) / n
+            self.mean = self.mean + delta * n_b / n
+            self._count = n
+        return self
+
+    def __call__(self, x: Array) -> Array:
+        if self.mean is None:
+            raise RuntimeError("Normalizer not adapted and no mean/variance given")
+        xp = _xp(x)
+        mean = xp.asarray(self.mean, dtype=xp.float32)
+        std = xp.sqrt(xp.asarray(self.variance, dtype=xp.float32) + 1e-7)
+        return (x - mean) / std
+
+    def get_config(self) -> Dict:
+        return {
+            "mean": None if self.mean is None else np.asarray(self.mean).tolist(),
+            "variance": None
+            if self.variance is None
+            else np.asarray(self.variance).tolist(),
+        }
+
+    @classmethod
+    def from_config(cls, cfg: Dict) -> "Normalizer":
+        return cls(**cfg)
+
+
+class Discretization:
+    """Bucketize numeric values by boundaries; ``adapt`` picks quantile
+    boundaries (``num_bins``-iles) like the reference layer.  Output ids lie
+    in ``[0, num_bins)``; works under jit via searchsorted."""
+
+    def __init__(
+        self, bin_boundaries: Optional[Sequence[float]] = None, num_bins: int = 0
+    ):
+        self.bin_boundaries = (
+            None if bin_boundaries is None else [float(b) for b in bin_boundaries]
+        )
+        self.num_bins = num_bins
+        self._samples: List[np.ndarray] = []
+
+    def adapt(
+        self, data: Union[Array, Iterable[Array]], max_samples: int = 1_000_000
+    ) -> "Discretization":
+        if not self.num_bins:
+            raise ValueError("adapt() needs num_bins")
+        rng = np.random.default_rng(0)
+        for batch in _batches(data):
+            flat = batch.astype(np.float64).ravel()
+            if len(flat) > max_samples:
+                flat = rng.choice(flat, max_samples, replace=False)
+            self._samples.append(flat)
+        sample = np.concatenate(self._samples)
+        if len(sample) > max_samples:  # keep the reservoir bounded
+            sample = rng.choice(sample, max_samples, replace=False)
+            self._samples = [sample]
+        qs = np.linspace(0, 1, self.num_bins + 1)[1:-1]
+        self.bin_boundaries = np.quantile(sample, qs).tolist()
+        return self
+
+    def __call__(self, x: Array) -> Array:
+        if self.bin_boundaries is None:
+            raise RuntimeError("Discretization not adapted and no boundaries given")
+        xp = _xp(x)
+        bounds = xp.asarray(self.bin_boundaries, dtype=xp.float32)
+        return xp.searchsorted(bounds, xp.asarray(x, dtype=xp.float32)).astype(
+            xp.int64 if xp is np else xp.int32
+        )
+
+    def get_config(self) -> Dict:
+        return {"bin_boundaries": self.bin_boundaries, "num_bins": self.num_bins}
+
+    @classmethod
+    def from_config(cls, cfg: Dict) -> "Discretization":
+        return cls(**cfg)
+
+
+class RoundIdentity:
+    """Round a numeric feature to an integer id, clipped to ``[0, max_value)``
+    (the reference's RoundIdentity feeds embedding lookups this way)."""
+
+    def __init__(self, max_value: int):
+        if max_value <= 0:
+            raise ValueError("max_value must be positive")
+        self.max_value = max_value
+
+    def __call__(self, x: Array) -> Array:
+        xp = _xp(x)
+        rounded = xp.round(xp.asarray(x, dtype=xp.float32))
+        return xp.clip(rounded, 0, self.max_value - 1).astype(
+            xp.int64 if xp is np else xp.int32
+        )
+
+    def get_config(self) -> Dict:
+        return {"max_value": self.max_value}
+
+    @classmethod
+    def from_config(cls, cfg: Dict) -> "RoundIdentity":
+        return cls(**cfg)
+
+
+class ToNumber:
+    """Parse string/bytes features to numbers host-side (feed stage); numeric
+    input passes through.  Empty/invalid strings map to ``default``."""
+
+    def __init__(self, out_dtype: str = "float32", default: float = 0.0):
+        self.out_dtype = out_dtype
+        self.default = default
+
+    def __call__(self, x: Array) -> Array:
+        arr = np.asarray(x)
+        if arr.dtype.kind not in ("U", "S", "O"):
+            return arr.astype(self.out_dtype)
+
+        def parse(s):
+            if isinstance(s, bytes):
+                s = s.decode()
+            s = s.strip()
+            if not s:
+                return self.default
+            try:
+                return float(s)
+            except ValueError:
+                return self.default
+
+        flat = np.array([parse(s) for s in arr.ravel()], np.float64)
+        return flat.reshape(arr.shape).astype(self.out_dtype)
+
+    def get_config(self) -> Dict:
+        return {"out_dtype": self.out_dtype, "default": self.default}
+
+    @classmethod
+    def from_config(cls, cfg: Dict) -> "ToNumber":
+        return cls(**cfg)
+
+
+class ConcatenateWithOffset:
+    """Concatenate per-feature id arrays into one id space: feature ``i``'s
+    ids are shifted by the total size of features ``0..i-1`` so a single
+    shared embedding table serves them all (the reference uses this to merge
+    feature columns into its PS-sharded Embedding)."""
+
+    def __init__(self, sizes: Sequence[int]):
+        self.sizes = [int(s) for s in sizes]
+        self.offsets = np.concatenate([[0], np.cumsum(self.sizes)[:-1]]).astype(
+            np.int64
+        )
+        self.total_size = int(np.sum(self.sizes))
+
+    def __call__(self, features: Sequence[Array]) -> Array:
+        if len(features) != len(self.sizes):
+            raise ValueError(
+                f"expected {len(self.sizes)} features, got {len(features)}"
+            )
+        xp = _xp(features[0])
+        cols = []
+        for i, f in enumerate(features):
+            f = xp.asarray(f)
+            col = f if f.ndim > 1 else f[:, None]
+            cols.append(col + int(self.offsets[i]))
+        return xp.concatenate(cols, axis=-1)
+
+    def get_config(self) -> Dict:
+        return {"sizes": self.sizes}
+
+    @classmethod
+    def from_config(cls, cfg: Dict) -> "ConcatenateWithOffset":
+        return cls(**cfg)

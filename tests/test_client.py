@@ -27,7 +27,9 @@ def test_master_manifest_render():
     container = m["spec"]["containers"][0]
     assert container["image"] == "zoo:v2"
     assert container["command"] == ["python", "-m", "elasticdl_tpu.master.main"]
-    env = {e["name"]: e["value"] for e in container["env"]}
+    env = {e["name"]: e["value"] for e in container["env"] if "value" in e}
+    # the downward-API pod IP the master advertises to workers
+    assert any(e["name"] == "MY_POD_IP" and "valueFrom" in e for e in container["env"])
     roundtrip = JobConfig.from_json(env["ELASTICDL_JOB_CONFIG"])
     assert roundtrip.job_name == "j1"
     assert roundtrip.training_data == "/data/x.rio"
@@ -58,6 +60,7 @@ def test_cli_train_manifest_out(tmp_path):
     env = {
         e["name"]: e["value"]
         for e in manifest["spec"]["containers"][0]["env"]
+        if "value" in e
     }
     cfg = JobConfig.from_json(env["ELASTICDL_JOB_CONFIG"])
     assert cfg.job_type == "training"
@@ -67,8 +70,9 @@ def test_cli_train_manifest_out(tmp_path):
 def test_zoo_init_build_cycle(tmp_path):
     zoo_dir = str(tmp_path / "myzoo")
     zoo.zoo_init(zoo_dir)
-    specs = zoo.discover_model_specs(zoo_dir)
+    specs, import_failures = zoo.discover_model_specs(zoo_dir)
     assert any("template" in k for k in specs)
+    assert import_failures == []
     assert zoo.zoo_build(zoo_dir, validate_only=True) == 0
     # init is idempotent: re-running keeps existing files
     zoo.zoo_init(zoo_dir)
@@ -81,6 +85,20 @@ def test_zoo_build_reports_bad_model(tmp_path):
     (zoo_dir / "broken.py").write_text(
         "def model_spec():\n    return object()\n"
     )
+    assert zoo.zoo_build(str(zoo_dir), validate_only=True) == 1
+
+
+def test_zoo_build_reports_import_error(tmp_path):
+    zoo_dir = tmp_path / "importzoo"
+    zoo_dir.mkdir()
+    (zoo_dir / "__init__.py").write_text("")
+    (zoo_dir / "broken.py").write_text("import nonexistent_pkg_xyz\n")
+    (zoo_dir / "ok.py").write_text(
+        "from elasticdl_tpu.models.mnist import model_spec\n"
+    )
+    # broken module is reported, but the good module still validates
+    failures = zoo.validate_zoo(str(zoo_dir))
+    assert any("import failed" in err for _, err in failures)
     assert zoo.zoo_build(str(zoo_dir), validate_only=True) == 1
 
 
