@@ -150,10 +150,35 @@ class CompositeDataReader(AbstractDataReader):
         return sorted(self._by_source)
 
 
+def _split_table_path(data_path: str) -> tuple:
+    """Split ``db.sqlite#tablename`` — but only when the full string isn't
+    itself an existing path, so filenames containing '#' keep working."""
+    if os.path.exists(data_path):
+        return data_path, ""
+    path, _, table = data_path.partition("#")
+    return path, table
+
+
+def _make_table_reader(data_path: str, **params) -> AbstractDataReader:
+    from elasticdl_tpu.data.table import TableDataReader
+
+    path, table = _split_table_path(data_path)
+    if table:
+        params.setdefault("table", table)
+    files = _expand(path)
+    if len(files) == 1:
+        return TableDataReader(files[0], **params)
+    # A directory/glob of database files: one reader per file, routed by
+    # shard name (each table reader's source is "<file>#<table>").
+    return CompositeDataReader([TableDataReader(f, **params) for f in files])
+
+
 _READERS = {
     "recordio": RecordIODataReader,
     "csv": CSVDataReader,
     "text": CSVDataReader,
+    "table": _make_table_reader,  # ODPS-table parity (SQLite-backed)
+    "sqlite": _make_table_reader,
 }
 
 
@@ -163,17 +188,24 @@ def create_data_reader(
     """Build a reader for ``data_path``.
 
     ``reader_params`` (the config's ``--data_reader_params``) may carry
-    ``format=recordio|csv`` plus reader kwargs; default is sniffed from the
-    first file's magic bytes.
+    ``format=recordio|csv|table`` plus reader kwargs; default is sniffed
+    from the first file's magic bytes.
     """
     params = dict(reader_params or {})
     fmt = params.pop("format", None)
     if fmt is None:
-        first = _expand(data_path)[0]
+        first = _expand(_split_table_path(data_path)[0])[0]
         with open(first, "rb") as f:
             from elasticdl_tpu.data.recordio import MAGIC
+            from elasticdl_tpu.data.table import SQLITE_MAGIC
 
-            fmt = "recordio" if f.read(len(MAGIC)) == MAGIC else "csv"
+            head = f.read(max(len(MAGIC), len(SQLITE_MAGIC)))
+        if head.startswith(MAGIC):
+            fmt = "recordio"
+        elif head.startswith(SQLITE_MAGIC):
+            fmt = "table"
+        else:
+            fmt = "csv"
     if fmt not in _READERS:
         raise ValueError(f"unknown data format {fmt!r}, pick from {sorted(_READERS)}")
     return _READERS[fmt](data_path, **params)

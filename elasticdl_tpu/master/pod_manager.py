@@ -378,6 +378,7 @@ class PodManager:
         self._slot_gen: Dict[int, int] = {}
         self._desired = 0
         self._listeners: List[PodListener] = []
+        self._retry_timers: List[threading.Timer] = []
         self._relaunch = config.relaunch_on_worker_failure
         self._max_relaunch = config.max_worker_relaunch
         backend.set_event_callback(self._on_event)
@@ -446,6 +447,9 @@ class PodManager:
     def stop(self) -> None:
         with self._lock:
             self._desired = 0
+            for timer in self._retry_timers:
+                timer.cancel()
+            self._retry_timers.clear()
             live = [
                 i.name
                 for i in self._slots.values()
@@ -498,12 +502,19 @@ class PodManager:
                 # A failed relaunch (OSError under memory pressure, transient
                 # k8s API error, ...) must not unwind into the backend's
                 # watcher thread — that would kill the only thread observing
-                # pod events and freeze elasticity.  Treat it as an immediate
-                # pod failure instead: the normal FAILED path re-relaunches
-                # while this slot's budget lasts (bounded recursion), then
-                # retires the slot with a warning.
+                # pod events and freeze elasticity.  Schedule the next
+                # attempt after a backoff: instant retries would burn the
+                # slot's whole relaunch budget before any transient condition
+                # could clear.
                 logger.exception("relaunch of %s failed", relaunch_info.name)
-                self._on_event(relaunch_info.name, PodPhase.FAILED)
+                delay = min(2.0 ** relaunch_info.relaunches, 30.0)
+                timer = threading.Timer(
+                    delay, self._on_event, (relaunch_info.name, PodPhase.FAILED)
+                )
+                timer.daemon = True
+                with self._lock:
+                    self._retry_timers.append(timer)
+                timer.start()
 
     # -- introspection --
 

@@ -16,6 +16,7 @@ same contract:
 
 from __future__ import annotations
 
+import zlib
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Union
 
 import numpy as np
@@ -34,6 +35,17 @@ def _xp(x: Array):
     import jax.numpy as jnp
 
     return jnp
+
+
+def _norm_token(v: Any) -> Any:
+    """Normalize a vocab token to a JSON-safe python scalar: numpy scalars
+    unwrap, bytes decode (surrogateescape keeps arbitrary bytes reversible).
+    Applied at adapt/init AND lookup time so b'a' and 'a' resolve alike."""
+    if isinstance(v, np.generic):
+        v = v.item()
+    if isinstance(v, bytes):
+        return v.decode("utf-8", "surrogateescape")
+    return v
 
 
 def _batches(data: Union[Array, Iterable[Array]]) -> Iterable[np.ndarray]:
@@ -67,21 +79,22 @@ def _fnv1a_u32(data: np.ndarray) -> np.ndarray:
     return h
 
 
-def _fnv1a_bytes(s: bytes) -> int:
-    h = _FNV_OFFSET32
-    for b in s:
-        h = ((h ^ b) * _FNV_PRIME32) & 0xFFFFFFFF
-    return h
+def _hash_bytes(s: bytes) -> int:
+    # Strings never cross into jit, so the string hash only needs to be
+    # stable across processes — zlib.crc32 (one C call) keeps the feed path
+    # fast where a per-byte Python FNV loop would dominate batch assembly.
+    return zlib.crc32(s) & 0xFFFFFFFF
 
 
 class Hashing:
     """Hash integer or string features into ``[0, num_bins)``.
 
     The reference's Hashing layer wraps tf.strings.to_hash_bucket_fast; here
-    integers use a vectorized FNV-1a mix (stable across processes, so master
-    and every worker agree), strings hash host-side in ``feed``.  Integer
-    input under jit uses the same mix in jnp — identical results on host and
-    device.
+    integers use a vectorized 32-bit FNV-1a mix — identical in numpy and in
+    jnp under jit, so host and device agree — while strings (host-only by
+    nature) use crc32, one C call each, to keep feed-stage batch assembly
+    fast.  Both are stable across processes, so master and every worker
+    agree; integer and string inputs hash into unrelated bucket assignments.
     """
 
     def __init__(self, num_bins: int):
@@ -95,7 +108,7 @@ class Hashing:
             if arr.dtype.kind in ("U", "S", "O"):
                 flat = np.array(
                     [
-                        _fnv1a_bytes(
+                        _hash_bytes(
                             s.encode() if isinstance(s, str) else bytes(s)
                         )
                         % self.num_bins
@@ -142,7 +155,9 @@ class IndexLookup:
         self.num_oov = num_oov
         self.max_tokens = max_tokens
         self._counts: Dict[Any, int] = {}
-        self.vocabulary: List = list(vocabulary) if vocabulary is not None else []
+        self.vocabulary: List = (
+            [_norm_token(v) for v in vocabulary] if vocabulary is not None else []
+        )
         self._index: Dict[Any, int] = {}
         self._reindex()
 
@@ -164,6 +179,7 @@ class IndexLookup:
         for batch in _batches(data):
             values, counts = np.unique(batch.ravel(), return_counts=True)
             for v, c in zip(values.tolist(), counts.tolist()):
+                v = _norm_token(v)
                 self._counts[v] = self._counts.get(v, 0) + c
         ordered = sorted(self._counts.items(), key=lambda kv: (-kv[1], str(kv[0])))
         if self.max_tokens:
@@ -182,18 +198,21 @@ class IndexLookup:
             raise KeyError(f"{value!r} not in vocabulary (num_oov=0)")
         if isinstance(value, (int, np.integer)):
             return int(_fnv1a_u32(np.asarray([value]))[0] % self.num_oov)
-        data = value.encode() if isinstance(value, str) else bytes(value)
-        return _fnv1a_bytes(data) % self.num_oov
+        if isinstance(value, str):
+            data = value.encode("utf-8", "surrogateescape")
+        else:
+            data = bytes(value)
+        return _hash_bytes(data) % self.num_oov
 
     def __call__(self, x: Array) -> Array:
         if _numpy_like(x):
             arr = np.asarray(x)
+            index = self._index
             flat = np.array(
                 [
-                    self._index.get(v, None)
-                    if self._index.get(v, None) is not None
+                    index[v] if (v := _norm_token(raw)) in index
                     else self._oov_index(v)
-                    for v in arr.ravel().tolist()
+                    for raw in arr.ravel().tolist()
                 ],
                 np.int64,
             )
@@ -223,11 +242,9 @@ class IndexLookup:
         return jnp.where(hit, in_vocab, oov)
 
     def get_config(self) -> Dict:
+        # vocabulary is normalized to JSON-safe scalars at adapt/init time
         return {
-            "vocabulary": [
-                v.item() if isinstance(v, np.generic) else v
-                for v in self.vocabulary
-            ],
+            "vocabulary": list(self.vocabulary),
             "num_oov": self.num_oov,
             "max_tokens": self.max_tokens,
         }

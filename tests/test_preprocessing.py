@@ -140,6 +140,17 @@ def test_concatenate_with_offset():
         layer([a, b])
 
 
+def test_index_lookup_bytes_vocab_json_safe():
+    layer = IndexLookup(num_oov=1).adapt(np.array([b"x", b"y", b"x"]))
+    cfg = json.loads(json.dumps(layer.get_config()))
+    rebuilt = IndexLookup.from_config(cfg)
+    # bytes and str inputs resolve to the same indices, before and after
+    np.testing.assert_array_equal(
+        layer(np.array([b"x", "y", b"zzz"], object)),
+        rebuilt(np.array(["x", b"y", "zzz"], object)),
+    )
+
+
 def test_config_roundtrips_are_json_safe():
     layers = [
         Hashing(10),

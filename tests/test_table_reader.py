@@ -1,0 +1,172 @@
+"""Table (ODPS-parity) reader: shard math, column selection, routing, format
+sniffing, and an end-to-end census job reading from a table instead of files
+(SURVEY.md §2 #14)."""
+
+import sqlite3
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.data.reader import CompositeDataReader, create_data_reader
+from elasticdl_tpu.data.table import TableDataReader, write_table
+
+
+@pytest.fixture()
+def db(tmp_path):
+    path = str(tmp_path / "data.db")
+    rows = [(i, f"name{i}", i * 0.5) for i in range(25)]
+    write_table(path, rows, ["id", "name", "score"])
+    return path
+
+
+def test_shards_and_ranges(db):
+    reader = TableDataReader(db)
+    shards = reader.create_shards(10)
+    assert [(s.start, s.end) for s in shards] == [(0, 10), (10, 20), (20, 25)]
+    assert shards[0].name.endswith("#records")
+    recs = list(reader.read_records(shards[1]))
+    assert len(recs) == 10
+    assert recs[0] == b"10,name10,5.0"
+
+
+def test_column_selection_and_delimiter(db):
+    reader = TableDataReader(db, columns=["score", "id"], delimiter="\t")
+    [shard] = reader.create_shards(100)
+    recs = list(reader.read_records(shard))
+    assert recs[3] == b"1.5\t3"
+
+
+def test_unknown_column_and_table(db):
+    with pytest.raises(ValueError, match="unknown columns"):
+        TableDataReader(db, columns=["nope"])
+    with pytest.raises(ValueError, match="no table"):
+        TableDataReader(db, table="nope")
+
+
+def test_multi_table_requires_selection(tmp_path):
+    path = str(tmp_path / "multi.db")
+    write_table(path, [(1,)], ["a"], table="t1")
+    conn = sqlite3.connect(path)
+    conn.execute("CREATE TABLE t2 (b)")
+    conn.commit()
+    conn.close()
+    with pytest.raises(ValueError, match="several tables"):
+        TableDataReader(path)
+    reader = TableDataReader(path, table="t1")
+    assert reader.sources() == [f"{path}#t1"]
+
+
+def test_create_data_reader_sniffs_sqlite(db):
+    reader = create_data_reader(db)
+    assert isinstance(reader, TableDataReader)
+    # path#table selection through the factory
+    reader2 = create_data_reader(f"{db}#records")
+    [shard] = reader2.create_shards(1000)
+    assert shard.size == 25
+
+
+def test_composite_routing_across_table_and_csv(db, tmp_path):
+    csv = tmp_path / "extra.csv"
+    csv.write_text("x,y\n1,2\n")
+    composite = CompositeDataReader(
+        [create_data_reader(db), create_data_reader(str(csv))]
+    )
+    shards = composite.create_shards(100)
+    by_source = {s.name: s for s in shards}
+    assert len(by_source) == 2
+    for shard in shards:
+        assert list(composite.read_records(shard))
+
+
+def test_sparse_rowids_after_deletion(tmp_path):
+    """Deleted rows break rowid density; the reader must fall back to
+    OFFSET pagination and still serve every surviving row exactly once."""
+    path = str(tmp_path / "holes.db")
+    write_table(path, [(i,) for i in range(30)], ["v"])
+    conn = sqlite3.connect(path)
+    conn.execute("DELETE FROM records WHERE v % 3 = 0")
+    conn.commit()
+    conn.close()
+    reader = TableDataReader(path)
+    shards = reader.create_shards(7)
+    got = [r for s in shards for r in reader.read_records(s)]
+    assert sorted(int(r) for r in got) == [
+        i for i in range(30) if i % 3 != 0
+    ]
+
+
+def test_filename_with_hash_char(tmp_path):
+    """'#' in a real filename must not be eaten by the table-name syntax."""
+    weird = tmp_path / "part#1.csv"
+    weird.write_text("a,b\nc,d\n")
+    reader = create_data_reader(str(weird))
+    [shard] = reader.create_shards(10)
+    assert list(reader.read_records(shard)) == [b"a,b", b"c,d"]
+
+
+def test_db_directory_composite(tmp_path):
+    d = tmp_path / "dbs"
+    d.mkdir()
+    write_table(str(d / "a.db"), [(1,), (2,)], ["x"])
+    write_table(str(d / "b.db"), [(3,)], ["x"])
+    reader = create_data_reader(str(d))
+    shards = reader.create_shards(10)
+    got = sorted(
+        int(r) for s in shards for r in reader.read_records(s)
+    )
+    assert got == [1, 2, 3]
+
+
+def test_null_values_serialize_empty(tmp_path):
+    path = str(tmp_path / "nulls.db")
+    write_table(path, [(1, None), (None, "b")], ["a", "b"])
+    reader = TableDataReader(path)
+    [shard] = reader.create_shards(10)
+    assert list(reader.read_records(shard)) == [b"1,", b",b"]
+
+
+def test_census_job_from_table(tmp_path, devices):
+    """Full worker loop with training data in a table: the reference's
+    ODPS-backed training path."""
+    from elasticdl_tpu.common.config import DistributionStrategy, JobConfig
+    from elasticdl_tpu.data.synthetic import generate
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.models.spec import load_model_spec
+    from elasticdl_tpu.worker.worker import DirectMasterProxy, Worker
+
+    csv_path = str(tmp_path / "census.csv")
+    generate("census", csv_path, 64)
+    rows = [
+        line.split(",")
+        for line in open(csv_path).read().splitlines()
+        if line
+    ]
+    path = str(tmp_path / "census.db")
+    write_table(
+        path,
+        rows,
+        ["label", "age", "education_num", "capital_gain", "capital_loss",
+         "hours_per_week", "workclass", "education", "marital_status",
+         "occupation", "relationship", "race", "sex", "native_country",
+         "extra_cat"],
+    )
+    config = JobConfig(
+        model_def="wide_deep.model_spec",
+        model_params="compute_dtype=float32;buckets=64;hidden=8",
+        training_data=path,
+        minibatch_size=16,
+        num_minibatches_per_task=2,
+        distribution_strategy=DistributionStrategy.PARAMETER_SERVER,
+    )
+    reader = create_data_reader(path)
+    dispatcher = TaskDispatcher(reader.create_shards(32), num_epochs=1)
+    servicer = MasterServicer(dispatcher)
+    spec = load_model_spec(
+        "elasticdl_tpu.models", "wide_deep.model_spec",
+        **config.parsed_model_params(),
+    )
+    worker = Worker(config, DirectMasterProxy(servicer), reader, spec=spec)
+    result = worker.run()
+    assert result["tasks_done"] == 2
+    assert servicer.job_finished()
