@@ -1,0 +1,24 @@
+"""Platform selection helper.
+
+Some images register an out-of-process TPU PJRT plugin from
+``sitecustomize`` and force ``jax_platforms`` to it at interpreter start,
+overriding the ``JAX_PLATFORMS`` environment variable.  Worker/master
+subprocesses spawned with ``JAX_PLATFORMS=cpu`` (tests, CPU-only control
+planes) would silently grab the TPU anyway — and hang or fight the parent
+for the chip.  Calling :func:`apply_platform_env` right after process start
+re-asserts the environment variable's choice through ``jax.config``, which
+wins over the sitecustomize default.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_platform_env() -> None:
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if not platforms:
+        return
+    import jax
+
+    jax.config.update("jax_platforms", platforms)

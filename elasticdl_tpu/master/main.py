@@ -22,6 +22,9 @@ from typing import Dict, List, Optional
 
 from elasticdl_tpu.common.config import JobConfig, parse_args
 from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.common.platform import apply_platform_env
+
+apply_platform_env()
 from elasticdl_tpu.data.reader import create_data_reader
 from elasticdl_tpu.master.evaluation_service import EvaluationService
 from elasticdl_tpu.master.pod_manager import (

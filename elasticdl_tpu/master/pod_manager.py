@@ -513,6 +513,10 @@ class PodManager:
                 )
                 timer.daemon = True
                 with self._lock:
+                    # prune fired timers so the list stays bounded
+                    self._retry_timers = [
+                        t for t in self._retry_timers if t.is_alive()
+                    ]
                     self._retry_timers.append(timer)
                 timer.start()
 

@@ -33,7 +33,11 @@ from elasticdl_tpu.models.tabular import (
     fuse_feature_ids,
     log_normalize,
 )
-from elasticdl_tpu.ops.embedding import ParallelContext, embedding_lookup, pad_vocab
+from elasticdl_tpu.ops.embedding import (
+    ParallelContext,
+    embedding_lookup,
+    init_flat_table,
+)
 
 NUM_DENSE = 13
 NUM_CAT = 26
@@ -45,13 +49,14 @@ def _init_params(
     embedding_dim: int,
     hidden: tuple,
 ) -> Dict[str, Any]:
-    vocab = pad_vocab(NUM_CAT * buckets_per_feature)
+    vocab = NUM_CAT * buckets_per_feature
     ks = jax.random.split(rng, 4 + len(hidden))
     glorot = jax.nn.initializers.glorot_normal()
     params: Dict[str, Any] = {
-        # Sharded tables (the "parameter server" part).
-        "fm_embedding": jax.random.normal(ks[0], (vocab, embedding_dim)) * 0.01,
-        "fm_linear": jax.random.normal(ks[1], (vocab, 1)) * 0.01,
+        # Sharded tables (the "parameter server" part), stored FLAT — see
+        # ops/embedding.py: contiguous-slice gathers are the TPU fast path.
+        "fm_embedding": init_flat_table(ks[0], vocab, embedding_dim),
+        "fm_linear": init_flat_table(ks[1], vocab, 1),
         # Replicated dense params (the "allreduce" part).
         "dense_linear": {
             "w": jnp.zeros((NUM_DENSE, 1), jnp.float32),
@@ -79,14 +84,15 @@ def _apply(
     train: bool = False,
     ctx: ParallelContext = ParallelContext(),
     buckets_per_feature: int = 0,
+    embedding_dim: int = 8,
     compute_dtype=jnp.bfloat16,
     **_,
 ):
     ids = fuse_feature_ids(batch["cat"], buckets_per_feature)  # [b, 26]
     dense = log_normalize(batch["dense"])  # [b, 13] f32
 
-    emb = embedding_lookup(params["fm_embedding"], ids, ctx)  # [b, 26, d]
-    lin = embedding_lookup(params["fm_linear"], ids, ctx)  # [b, 26, 1]
+    emb = embedding_lookup(params["fm_embedding"], ids, ctx, dim=embedding_dim)
+    lin = embedding_lookup(params["fm_linear"], ids, ctx, dim=1)  # [b, 26, 1]
 
     emb = emb.astype(compute_dtype)
     dense_c = dense.astype(compute_dtype)
@@ -153,7 +159,10 @@ def model_spec(
             hidden=hidden,
         ),
         apply=functools.partial(
-            _apply, buckets_per_feature=buckets_per_feature, compute_dtype=dtype
+            _apply,
+            buckets_per_feature=buckets_per_feature,
+            embedding_dim=dim,
+            compute_dtype=dtype,
         ),
         loss=_loss,
         metrics=_metrics,

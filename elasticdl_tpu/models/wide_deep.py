@@ -33,7 +33,12 @@ from elasticdl_tpu.models.tabular import (
     hash_buckets,
     log_normalize,
 )
-from elasticdl_tpu.ops.embedding import ParallelContext, embedding_lookup, pad_vocab
+from elasticdl_tpu.ops.embedding import (
+    ParallelContext,
+    embedding_lookup,
+    flat_table_size,
+    init_flat_table,
+)
 
 NUM_DENSE = 5
 NUM_CAT = 9
@@ -52,13 +57,16 @@ def _wide_ids(cat: jax.Array, buckets: int) -> jax.Array:
 
 
 def _init_params(rng, buckets: int, embedding_dim: int, hidden: tuple):
-    wide_vocab = pad_vocab((NUM_CAT + len(_CROSSES)) * buckets)
-    deep_vocab = pad_vocab(NUM_CAT * buckets)
+    wide_vocab = (NUM_CAT + len(_CROSSES)) * buckets
+    deep_vocab = NUM_CAT * buckets
     ks = jax.random.split(rng, 3 + len(hidden))
     glorot = jax.nn.initializers.glorot_normal()
     params: Dict[str, Any] = {
-        "wide": jnp.zeros((wide_vocab, 1), jnp.float32),
-        "deep_embedding": jax.random.normal(ks[0], (deep_vocab, embedding_dim)) * 0.05,
+        # Flat tables — see ops/embedding.py for why (TPU gather layout).
+        "wide": jnp.zeros((flat_table_size(wide_vocab, 1),), jnp.float32),
+        "deep_embedding": init_flat_table(
+            ks[0], deep_vocab, embedding_dim, scale=0.05
+        ),
         "mlp": {},
         "bias": jnp.zeros((1,), jnp.float32),
     }
@@ -82,6 +90,7 @@ def _apply(
     train: bool = False,
     ctx: ParallelContext = ParallelContext(),
     buckets: int = 0,
+    embedding_dim: int = 8,
     compute_dtype=jnp.bfloat16,
     **_,
 ):
@@ -91,8 +100,10 @@ def _apply(
     wide_ids = _wide_ids(cat, buckets)
     deep_ids = fuse_feature_ids(cat, buckets)
 
-    wide_w = embedding_lookup(params["wide"], wide_ids, ctx)  # [b, nw, 1]
-    emb = embedding_lookup(params["deep_embedding"], deep_ids, ctx)  # [b, 9, d]
+    wide_w = embedding_lookup(params["wide"], wide_ids, ctx, dim=1)  # [b, nw, 1]
+    emb = embedding_lookup(
+        params["deep_embedding"], deep_ids, ctx, dim=embedding_dim
+    )  # [b, 9, d]
 
     wide = jnp.sum(wide_w[..., 0], axis=-1, dtype=jnp.float32)
 
@@ -142,7 +153,9 @@ def model_spec(
         init=functools.partial(
             _init_params, buckets=buckets, embedding_dim=embedding_dim, hidden=hidden
         ),
-        apply=functools.partial(_apply, buckets=buckets, compute_dtype=dtype),
+        apply=functools.partial(
+            _apply, buckets=buckets, embedding_dim=embedding_dim, compute_dtype=dtype
+        ),
         loss=_loss,
         metrics=_metrics,
         optimizer=optax.adam(learning_rate),

@@ -104,7 +104,8 @@ def _tree_psum_except(tree: Any, skip_paths, axis_name: str):
 
 def pad_embedding_tables(params: Any, tables: List[EmbeddingTableSpec]) -> Any:
     """Zero-pad each table's vocab axis to DEFAULT_VOCAB_MULTIPLE so shapes are
-    stable across every mesh size (see ops.embedding docstring)."""
+    stable across every mesh size (see ops.embedding docstring).  Flat 1-D
+    tables pad to pad_vocab(V)*dim; 2-D tables pad rows."""
     if not tables:
         return params
     flat = {t.path: t for t in tables}
@@ -113,11 +114,16 @@ def pad_embedding_tables(params: Any, tables: List[EmbeddingTableSpec]) -> Any:
         t = flat.get(_path_keys(path))
         if t is None:
             return leaf
-        padded = pad_vocab(t.vocab_size)
-        if leaf.shape[0] == padded:
+        target = pad_vocab(t.vocab_size) * (t.dim if leaf.ndim == 1 else 1)
+        if leaf.shape[0] == target:
             return leaf
+        if leaf.shape[0] > target:
+            raise ValueError(
+                f"table {t.path} has {leaf.shape[0]} leading entries, more "
+                f"than the padded size {target}"
+            )
         return jnp.concatenate(
-            [leaf, jnp.zeros((padded - leaf.shape[0],) + leaf.shape[1:], leaf.dtype)]
+            [leaf, jnp.zeros((target - leaf.shape[0],) + leaf.shape[1:], leaf.dtype)]
         )
 
     return jax.tree_util.tree_map_with_path(pad, params)

@@ -198,10 +198,12 @@ class IndexLookup:
             raise KeyError(f"{value!r} not in vocabulary (num_oov=0)")
         if isinstance(value, (int, np.integer)):
             return int(_fnv1a_u32(np.asarray([value]))[0] % self.num_oov)
-        if isinstance(value, str):
-            data = value.encode("utf-8", "surrogateescape")
+        if isinstance(value, bytes):
+            data = value
         else:
-            data = bytes(value)
+            # str, float, bool, ... — hash the canonical string form so any
+            # adapt()-able token type lands in a stable OOV bucket.
+            data = str(value).encode("utf-8", "surrogateescape")
         return _hash_bytes(data) % self.num_oov
 
     def __call__(self, x: Array) -> Array:

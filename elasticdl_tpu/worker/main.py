@@ -17,6 +17,9 @@ from typing import List, Optional
 
 from elasticdl_tpu.common.config import JobConfig, parse_args
 from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.common.platform import apply_platform_env
+
+apply_platform_env()
 from elasticdl_tpu.data.reader import (
     AbstractDataReader,
     CompositeDataReader,

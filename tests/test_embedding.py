@@ -48,6 +48,91 @@ def test_pad_vocab():
     assert pad_vocab(257) == 512
 
 
+def test_flat_lookup_matches_2d(devices):
+    """Flat [V*D] storage must agree with the 2-D [V, D] path, fwd and grad
+    (including duplicate-id accumulation)."""
+    from elasticdl_tpu.ops.embedding import gather_rows
+
+    table = _table(jax.random.key(0))
+    flat = table.reshape(-1)
+    ids = jnp.array([[3, 3], [0, 63], [17, 3]], jnp.int32)
+    ctx = ParallelContext()
+    out2 = embedding_lookup(table, ids, ctx)
+    out1 = embedding_lookup(flat, ids, ctx, dim=DIM)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(gather_rows(flat, ids, DIM)), np.asarray(out2), rtol=1e-6
+    )
+
+    cot = jax.random.normal(jax.random.key(2), out2.shape)
+    g2 = jax.grad(lambda t: jnp.sum(embedding_lookup(t, ids, ctx) * cot))(table)
+    g1 = jax.grad(
+        lambda t: jnp.sum(embedding_lookup(t, ids, ctx, dim=DIM) * cot)
+    )(flat)
+    np.testing.assert_allclose(
+        np.asarray(g1), np.asarray(g2).reshape(-1), rtol=1e-5
+    )
+
+
+def test_flat_lookup_dim_validation():
+    ctx = ParallelContext()
+    with pytest.raises(ValueError, match="explicit dim"):
+        embedding_lookup(jnp.zeros((64,)), jnp.zeros((2,), jnp.int32), ctx)
+    with pytest.raises(ValueError, match="dim"):
+        embedding_lookup(
+            jnp.zeros((64, 4)), jnp.zeros((2,), jnp.int32), ctx, dim=8
+        )
+
+
+@pytest.mark.parametrize("n_dev", [1, 4, 8])
+def test_sharded_flat_lookup_matches_gather(devices, n_dev):
+    mesh = create_mesh(devices, num_devices=n_dev)
+    axis = mesh.axis_names[0]
+    table = _table(jax.random.key(0))
+    flat = table.reshape(-1)
+    ids = jax.random.randint(jax.random.key(1), (32,), 0, VOCAB)
+    expected = jnp.take(table, ids, axis=0)
+
+    ctx = ParallelContext(axis_name=axis, sharded_embeddings=True)
+    mapped = shard_map(
+        lambda t, i: embedding_lookup(t, i, ctx, dim=DIM),
+        mesh=mesh,
+        in_specs=(P(axis), P(axis)),
+        out_specs=P(axis),
+        check_vma=False,
+    )
+    sh = lambda a: jax.device_put(a, NamedSharding(mesh, P(axis)))
+    out = jax.jit(mapped)(sh(flat), sh(ids))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), rtol=1e-6)
+
+
+def test_sharded_flat_gradient_duplicates(devices):
+    mesh = create_mesh(devices)
+    axis = mesh.axis_names[0]
+    table = _table(jax.random.key(0))
+    flat = table.reshape(-1)
+    ids = jnp.array([3, 3, 3, 3, 3, 3, 3, 3, 0, 1, 2, 4, 5, 6, 7, 8], jnp.int32)
+    cot = jax.random.normal(jax.random.key(2), (ids.shape[0], DIM))
+
+    expected = jax.grad(
+        lambda t: jnp.sum(jnp.take(t, ids, axis=0) * cot)
+    )(table).reshape(-1)
+
+    ctx = ParallelContext(axis_name=axis, sharded_embeddings=True)
+    mapped = shard_map(
+        jax.grad(
+            lambda t, i, c: jnp.sum(embedding_lookup(t, i, ctx, dim=DIM) * c)
+        ),
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis)),
+        out_specs=P(axis),
+        check_vma=False,
+    )
+    sh = lambda a: jax.device_put(a, NamedSharding(mesh, P(axis)))
+    grad = jax.jit(mapped)(sh(flat), sh(ids), sh(cot))
+    np.testing.assert_allclose(np.asarray(grad), np.asarray(expected), rtol=1e-5)
+
+
 @pytest.mark.parametrize("n_dev", [1, 4, 8])
 def test_sharded_lookup_matches_gather(devices, n_dev):
     mesh = create_mesh(devices, num_devices=n_dev)
