@@ -99,7 +99,8 @@ class JobConfig:
 
     # --- observability ---
     log_level: str = "INFO"
-    profile_dir: str = ""
+    profile_dir: str = ""  # worker: jax.profiler trace of one training task
+    metrics_dir: str = ""  # master: JSONL + TensorBoard scalar stream
 
     # --- precision ---
     compute_dtype: str = "bfloat16"  # MXU-native; params stay f32

@@ -1,0 +1,72 @@
+"""Metrics writer — the master's structured observability sink.
+
+Reference parity (SURVEY.md §5 "Metrics/logging/observability" [U — mount
+empty at survey time]): the reference surfaces eval metrics via gRPC to the
+master and optionally TensorBoard through Keras callbacks.  Here the master
+appends every training/eval metric report to a JSONL stream (one
+machine-parseable record per event, crash-safe append) and mirrors scalars
+to TensorBoard when ``tensorboardX`` is importable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("metrics")
+
+
+class MetricsWriter:
+    """Append-only JSONL scalar stream + optional TensorBoard mirror."""
+
+    def __init__(self, directory: str, tensorboard: bool = True):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._path = os.path.join(self.directory, "metrics.jsonl")
+        self._lock = threading.Lock()
+        self._tb = None
+        if tensorboard:
+            try:
+                from tensorboardX import SummaryWriter  # type: ignore
+
+                self._tb = SummaryWriter(
+                    logdir=os.path.join(self.directory, "tensorboard")
+                )
+            except Exception:  # pragma: no cover - tensorboardX optional
+                logger.info("tensorboardX unavailable; JSONL metrics only")
+
+    def write(self, kind: str, step: int, metrics: Dict[str, float]) -> None:
+        """Record one scalar group: kind is "train" | "eval" | custom."""
+        record = {
+            "ts": time.time(),
+            "kind": kind,
+            "step": int(step),
+            **{k: float(v) for k, v in metrics.items()},
+        }
+        line = json.dumps(record, sort_keys=True)
+        with self._lock:
+            with open(self._path, "a") as f:
+                f.write(line + "\n")
+            if self._tb is not None:
+                for key, value in metrics.items():
+                    self._tb.add_scalar(f"{kind}/{key}", float(value), int(step))
+
+    def close(self) -> None:
+        with self._lock:
+            if self._tb is not None:
+                self._tb.close()
+                self._tb = None
+
+
+def read_metrics(directory: str) -> list:
+    """All records of a job's metrics.jsonl (tests, CLI inspection)."""
+    path = os.path.join(os.path.abspath(directory), "metrics.jsonl")
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]

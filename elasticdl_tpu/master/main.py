@@ -94,11 +94,17 @@ class Master:
         self.rendezvous = RendezvousServer(
             heartbeat_timeout_s=heartbeat_timeout_s
         )
+        self.metrics_writer = None
+        if config.metrics_dir:
+            from elasticdl_tpu.common.metrics import MetricsWriter
+
+            self.metrics_writer = MetricsWriter(config.metrics_dir)
         self.servicer = MasterServicer(
             self.dispatcher,
             rendezvous=self.rendezvous,
             evaluation=self.evaluation,
             final_eval=self.evaluation is not None,
+            metrics_writer=self.metrics_writer,
         )
         self.server = MasterServer(
             self.servicer, port=port, advertise_host=self._advertise_host(config)
@@ -179,6 +185,8 @@ class Master:
     def shutdown(self) -> None:
         self.pod_manager.stop()
         self.server.stop()
+        if self.metrics_writer is not None:
+            self.metrics_writer.close()
 
 
 def main(argv: Optional[List[str]] = None) -> int:

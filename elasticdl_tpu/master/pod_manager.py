@@ -513,9 +513,13 @@ class PodManager:
                 )
                 timer.daemon = True
                 with self._lock:
-                    # prune fired timers so the list stays bounded
+                    # Prune timers that already fired or were cancelled so
+                    # the list stays bounded.  `finished` (set after run or
+                    # cancel) is the right predicate: is_alive() is also
+                    # False for appended-but-not-yet-started timers, which
+                    # must stay cancellable by stop().
                     self._retry_timers = [
-                        t for t in self._retry_timers if t.is_alive()
+                        t for t in self._retry_timers if not t.finished.is_set()
                     ]
                     self._retry_timers.append(timer)
                 timer.start()

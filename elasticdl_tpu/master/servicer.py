@@ -48,10 +48,13 @@ class MasterServicer:
         rendezvous: Optional[RendezvousServer] = None,
         evaluation: Optional[EvaluationService] = None,
         final_eval: bool = False,
+        metrics_writer=None,
     ):
         self.dispatcher = dispatcher
         self.rendezvous = rendezvous or RendezvousServer()
         self.evaluation = evaluation
+        self.metrics_writer = metrics_writer
+        self._written_eval_rounds = 0
         self._lock = threading.Lock()
         self._model_version = 0
         self._checkpoint: Dict[str, object] = {"path": None, "step": 0}
@@ -139,13 +142,37 @@ class MasterServicer:
                     float(req.get("weight", 1.0)),
                 )
             accepted = self.evaluation.report_task(task_id, success)
+            self._maybe_write_eval_metrics()
         else:
             accepted = self.dispatcher.report(
                 task_id, success, req.get("worker_id", "")
             )
+            if success and accepted and req.get("metrics") and self.metrics_writer:
+                self.metrics_writer.write(
+                    "train",
+                    int(req.get("model_version", self._model_version)),
+                    req["metrics"],
+                )
         if "model_version" in req:
             self._bump_version(int(req["model_version"]))
         return {"accepted": accepted}
+
+    def _maybe_write_eval_metrics(self) -> None:
+        """Record each completed eval round's aggregate exactly once.  The
+        check-and-set runs under the lock: ReportTaskResult handlers run on
+        the gRPC thread pool, and two workers finishing a round's last tasks
+        concurrently must not both (or neither) write it."""
+        if self.metrics_writer is None or self.evaluation is None:
+            return
+        with self._lock:
+            rounds = self.evaluation.completed_rounds()
+            if rounds <= self._written_eval_rounds:
+                return
+            self._written_eval_rounds = rounds
+            version = self._model_version
+        self.metrics_writer.write(
+            "eval", version, self.evaluation.latest_metrics()
+        )
 
     def ReportVersion(self, req: dict) -> dict:
         self._bump_version(int(req["model_version"]))

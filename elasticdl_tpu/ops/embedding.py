@@ -78,8 +78,20 @@ def pad_vocab(vocab_size: int, multiple: int = DEFAULT_VOCAB_MULTIPLE) -> int:
 
 
 def flat_table_size(vocab_size: int, dim: int) -> int:
-    """Storage length of a flat table with a padded vocab."""
-    return pad_vocab(vocab_size) * dim
+    """Storage length of a flat table with a padded vocab.
+
+    Flat offsets are computed as ``id * dim`` in int32 (jax's default —
+    x64 is disabled), so the whole table must stay addressable in int32;
+    beyond that the old 2-D path would be required (or id-space sharding
+    across multiple tables).  Raise loudly instead of wrapping silently.
+    """
+    size = pad_vocab(vocab_size) * dim
+    if size > 2**31 - 1:
+        raise ValueError(
+            f"flat table of {pad_vocab(vocab_size)} rows x dim {dim} exceeds "
+            "int32 addressing; shard the id space over multiple tables"
+        )
+    return size
 
 
 def init_flat_table(rng: jax.Array, vocab_size: int, dim: int, scale: float = 0.01):

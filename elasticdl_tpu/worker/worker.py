@@ -101,6 +101,7 @@ class Worker:
         self._ckpt: Optional[CheckpointManager] = None
         self._last_ckpt_step = 0
         self.reforms = 0  # elastic mesh re-formations (observability/tests)
+        self._training_tasks_done = 0  # gates the one-task profiler trace
 
         if config.checkpoint_dir:
             self._ckpt = CheckpointManager(
@@ -167,6 +168,24 @@ class Worker:
                 "ReportCheckpoint",
                 {"path": self._ckpt.directory, "step": step},
             )
+
+    # ---- profiling ----
+
+    def _maybe_start_profile(self):
+        """Trace the SECOND training task (the first pays compilation) into
+        ``config.profile_dir`` with ``jax.profiler`` — the reference's
+        TF-profiler-hook role (SURVEY.md §5 "Tracing/profiling").  Counts
+        training tasks only, so interleaved eval/predict tasks neither skip
+        the trace nor shift it onto a compiling step."""
+        if not self.config.profile_dir or self._training_tasks_done != 1:
+            return False
+        try:
+            jax.profiler.start_trace(self.config.profile_dir)
+            logger.info("profiling this task into %s", self.config.profile_dir)
+            return True
+        except Exception:
+            logger.exception("profiler start failed")
+            return False
 
     # ---- task execution ----
 
@@ -250,7 +269,14 @@ class Worker:
             }
             try:
                 if task.type == TASK_TRAINING:
-                    metrics = self._run_training_task(task)
+                    profiling = self._maybe_start_profile()
+                    try:
+                        metrics = self._run_training_task(task)
+                    finally:
+                        if profiling:
+                            jax.block_until_ready(self.state)
+                            jax.profiler.stop_trace()
+                    self._training_tasks_done += 1
                     report["metrics"] = metrics
                     report["model_version"] = int(self.state.step)
                 elif task.type == TASK_EVALUATION:

@@ -74,6 +74,14 @@ def test_flat_lookup_matches_2d(devices):
     )
 
 
+def test_flat_table_int32_guard():
+    from elasticdl_tpu.ops.embedding import flat_table_size
+
+    assert flat_table_size(1000, 8) == 1024 * 8
+    with pytest.raises(ValueError, match="int32"):
+        flat_table_size(300_000_000, 8)
+
+
 def test_flat_lookup_dim_validation():
     ctx = ParallelContext()
     with pytest.raises(ValueError, match="explicit dim"):

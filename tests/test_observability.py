@@ -1,0 +1,118 @@
+"""Observability: metrics JSONL stream from train/eval reports, profiler
+trace capture in the worker loop (SURVEY.md §5)."""
+
+import glob
+import os
+
+import pytest
+
+from elasticdl_tpu.common.config import JobConfig
+from elasticdl_tpu.common.metrics import MetricsWriter, read_metrics
+from elasticdl_tpu.data.reader import create_data_reader
+from elasticdl_tpu.data.synthetic import generate
+from elasticdl_tpu.master.evaluation_service import EvaluationService
+from elasticdl_tpu.master.servicer import MasterServicer
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.models.spec import load_model_spec
+from elasticdl_tpu.worker.worker import DirectMasterProxy, Worker
+
+
+def test_metrics_writer_roundtrip(tmp_path):
+    writer = MetricsWriter(str(tmp_path), tensorboard=False)
+    writer.write("train", 3, {"loss": 1.5, "accuracy": 0.5})
+    writer.write("eval", 3, {"loss": 1.2})
+    writer.close()
+    records = read_metrics(str(tmp_path))
+    assert len(records) == 2
+    assert records[0]["kind"] == "train"
+    assert records[0]["step"] == 3
+    assert records[0]["loss"] == 1.5
+    assert records[1]["kind"] == "eval"
+
+
+def test_metrics_writer_tensorboard(tmp_path):
+    pytest.importorskip("tensorboardX")
+    writer = MetricsWriter(str(tmp_path))
+    writer.write("train", 1, {"loss": 2.0})
+    writer.close()
+    events = glob.glob(str(tmp_path / "tensorboard" / "events*"))
+    assert events, "expected a tensorboard event file"
+
+
+def test_read_metrics_missing_dir(tmp_path):
+    assert read_metrics(str(tmp_path / "nope")) == []
+
+
+def _job(tmp_path, **cfg):
+    train = str(tmp_path / "train.rio")
+    val = str(tmp_path / "val.rio")
+    generate("mnist", train, 64)
+    generate("mnist", val, 32)
+    config = JobConfig(
+        model_def="mnist.model_spec",
+        model_params="compute_dtype=float32",
+        training_data=train,
+        validation_data=val,
+        minibatch_size=16,
+        num_minibatches_per_task=2,
+        **cfg,
+    )
+    reader = create_data_reader(train)
+    per_task = config.minibatch_size * config.num_minibatches_per_task
+    dispatcher = TaskDispatcher(reader.create_shards(per_task))
+    evaluation = EvaluationService(
+        create_data_reader(val).create_shards(per_task), evaluation_steps=2
+    )
+    spec = load_model_spec(
+        "elasticdl_tpu.models", "mnist.model_spec", compute_dtype="float32"
+    )
+    return config, dispatcher, evaluation, reader, spec
+
+
+class _MuxReader:
+    def __init__(self, *readers):
+        self._readers = readers
+
+    def read_records(self, shard):
+        for r in self._readers:
+            if shard.name in r.sources():
+                return r.read_records(shard)
+        raise KeyError(shard.name)
+
+
+def test_master_writes_train_and_eval_metrics(tmp_path, devices):
+    config, dispatcher, evaluation, reader, spec = _job(tmp_path)
+    writer = MetricsWriter(str(tmp_path / "metrics"), tensorboard=False)
+    servicer = MasterServicer(
+        dispatcher, evaluation=evaluation, metrics_writer=writer
+    )
+    val_reader = create_data_reader(str(tmp_path / "val.rio"))
+    worker = Worker(
+        config,
+        DirectMasterProxy(servicer),
+        _MuxReader(reader, val_reader),
+        spec=spec,
+    )
+    worker.run()
+    writer.close()
+    records = read_metrics(str(tmp_path / "metrics"))
+    kinds = {r["kind"] for r in records}
+    assert "train" in kinds
+    assert "eval" in kinds
+    train_records = [r for r in records if r["kind"] == "train"]
+    assert all("loss" in r for r in train_records)
+    # eval rounds recorded once each
+    eval_records = [r for r in records if r["kind"] == "eval"]
+    assert len(eval_records) == evaluation.completed_rounds()
+
+
+def test_worker_profiler_trace(tmp_path, devices):
+    prof = str(tmp_path / "prof")
+    config, dispatcher, evaluation, reader, spec = _job(
+        tmp_path, profile_dir=prof
+    )
+    servicer = MasterServicer(dispatcher)
+    worker = Worker(config, DirectMasterProxy(servicer), reader, spec=spec)
+    worker.run()
+    traces = glob.glob(os.path.join(prof, "**", "*.xplane.pb"), recursive=True)
+    assert traces, "expected an xplane trace from the profiled task"
