@@ -83,6 +83,11 @@ class JobConfig:
     # for local backends, this pod's IP (MY_POD_IP downward API) or FQDN for
     # the kubernetes backend.
     master_advertise_host: str = ""
+    # Multi-host: workers advertise their host and join a jax.distributed
+    # world (rank 0 hosts the coordination service on this port) so one mesh
+    # spans every worker's chips.  Leave False for single-host jobs.
+    multihost: bool = False
+    coordinator_port: int = 8476
 
     # --- elasticity ---
     relaunch_on_worker_failure: bool = True

@@ -148,7 +148,7 @@ class Master:
 
     # Pod death cascades: membership bump -> servicer listener requeues tasks.
     def _on_pod_event(self, pod_name: str, phase: str) -> None:
-        if phase in (PodPhase.FAILED, PodPhase.DELETED, PodPhase.SUCCEEDED):
+        if phase in PodPhase.TERMINAL:
             self.rendezvous.remove(pod_name)
 
     def scale(self, n: int) -> None:

@@ -49,8 +49,17 @@ class PodPhase:
     SUCCEEDED = "Succeeded"
     FAILED = "Failed"
     DELETED = "Deleted"
+    # Worker asked to be restarted (multihost elastic re-join, exit code 3);
+    # relaunched WITHOUT consuming the slot's failure budget.
+    RESTART = "Restart"
 
-    TERMINAL = (SUCCEEDED, FAILED, DELETED)
+    TERMINAL = (SUCCEEDED, FAILED, DELETED, RESTART)
+
+
+# Exit code the worker main uses to request a budget-free relaunch
+# (worker.worker.RESTART_EXIT_CODE; duplicated to keep this module
+# importable without jax).
+WORKER_RESTART_EXIT_CODE = 3
 
 
 @dataclasses.dataclass
@@ -181,10 +190,13 @@ class ProcessPodBackend(PodBackend):
                     for name, _ in done:
                         del self._procs[name]
                 for name, rc in done:
-                    self._emit(
-                        name,
-                        PodPhase.SUCCEEDED if rc == 0 else PodPhase.FAILED,
-                    )
+                    if rc == 0:
+                        phase = PodPhase.SUCCEEDED
+                    elif rc == WORKER_RESTART_EXIT_CODE:
+                        phase = PodPhase.RESTART
+                    else:
+                        phase = PodPhase.FAILED
+                    self._emit(name, phase)
             except Exception:
                 # The watcher is the only observer of worker exits; it must
                 # survive any emit-chain error or elasticity silently dies.
@@ -469,7 +481,14 @@ class PodManager:
             if info is None:
                 return
             info.phase = phase
-            if phase == PodPhase.FAILED:
+            if phase == PodPhase.RESTART:
+                # Requested restart (multihost elastic re-join): relaunch
+                # into the slot without touching the failure budget.
+                if self._slots.get(info.slot) is info:
+                    relaunch_info = self._new_pod_locked(
+                        info.slot, info.relaunches
+                    )
+            elif phase == PodPhase.FAILED:
                 in_fleet = self._slots.get(info.slot) is info
                 if (
                     in_fleet

@@ -27,6 +27,9 @@ class RendezvousServer:
     ):
         self._lock = threading.Lock()
         self._workers: Dict[str, float] = {}  # worker_id -> last heartbeat
+        # worker_id -> advertised host (multi-host: seeds the rank-0
+        # jax.distributed coordinator; empty for single-host workers)
+        self._addresses: Dict[str, str] = {}
         self._version = 0
         self._timeout = heartbeat_timeout_s
         self._clock = clock
@@ -40,17 +43,26 @@ class RendezvousServer:
         for fn in self._listeners:
             fn(version, members)
 
-    def register(self, worker_id: str) -> int:
-        """Worker joins (or re-joins). Returns the new membership version."""
+    def register(self, worker_id: str, address: str = "") -> int:
+        """Worker joins (or re-joins). Returns the new membership version.
+
+        A re-registration with a CHANGED address also bumps the version:
+        peers cache the coordinator address from the membership view, and a
+        worker restarted on a new host within the heartbeat window would
+        otherwise never be re-discovered.
+        """
         with self._lock:
-            is_new = worker_id not in self._workers
+            changed = worker_id not in self._workers or (
+                bool(address) and self._addresses.get(worker_id) != address
+            )
             self._workers[worker_id] = self._clock()
-            if is_new:
-                self._version += 1
-                members = sorted(self._workers)
-                version = self._version
-            else:
+            if address:
+                self._addresses[worker_id] = address
+            if not changed:
                 return self._version
+            self._version += 1
+            members = sorted(self._workers)
+            version = self._version
         self._notify(version, members)
         return version
 
@@ -59,6 +71,7 @@ class RendezvousServer:
             if worker_id not in self._workers:
                 return self._version
             del self._workers[worker_id]
+            self._addresses.pop(worker_id, None)
             self._version += 1
             version, members = self._version, sorted(self._workers)
         self._notify(version, members)
@@ -83,6 +96,7 @@ class RendezvousServer:
                 return []
             for w in dead:
                 del self._workers[w]
+                self._addresses.pop(w, None)
             self._version += 1
             version, members = self._version, sorted(self._workers)
         self._notify(version, members)
@@ -97,6 +111,9 @@ class RendezvousServer:
                 "workers": members,
                 "ranks": {w: i for i, w in enumerate(members)},
                 "world_size": len(members),
+                "addresses": {
+                    w: self._addresses[w] for w in members if w in self._addresses
+                },
             }
 
     def version(self) -> int:

@@ -170,9 +170,11 @@ class MasterServicer:
                 return
             self._written_eval_rounds = rounds
             version = self._model_version
-        self.metrics_writer.write(
-            "eval", version, self.evaluation.latest_metrics()
-        )
+            # Snapshot INSIDE the lock: if round N+1 completes while this
+            # thread is descheduled, a late read would record N+1's
+            # aggregate under N's slot and lose N's entirely.
+            metrics = self.evaluation.latest_metrics()
+        self.metrics_writer.write("eval", version, metrics)
 
     def ReportVersion(self, req: dict) -> dict:
         self._bump_version(int(req["model_version"]))
@@ -186,7 +188,7 @@ class MasterServicer:
             self.evaluation.maybe_trigger(current)
 
     def RegisterWorker(self, req: dict) -> dict:
-        self.rendezvous.register(req["worker_id"])
+        self.rendezvous.register(req["worker_id"], req.get("address", ""))
         self._known_workers.add(req["worker_id"])
         return self.rendezvous.membership()
 

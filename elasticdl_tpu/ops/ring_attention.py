@@ -1,0 +1,115 @@
+"""Ring attention — sequence/context parallelism over the device mesh.
+
+The reference framework predates long-context models (its models are
+tabular/CNN — SURVEY.md §2 parallelism table: SP/CP absent), but this
+rebuild treats long-context as first-class: sequences too long for one
+device's HBM shard along a ``sp`` mesh axis, and attention runs blockwise
+while key/value blocks rotate around the ring via ``lax.ppermute`` —
+compute on the current block overlaps the ICI transfer of the next, so the
+ring costs ~one extra block of latency, not a full all-gather of K/V.
+
+Math: classic streaming-softmax (flash-style) accumulation.  Each step
+processes one K/V block against the local Q block, carrying a running
+row-max ``m``, normalizer ``l``, and unnormalized output ``o``; exact to
+fp error regardless of block order.  Causal masking uses global positions
+(device rank × block length + offset), so the sharded result equals the
+unsharded lower-triangular mask.
+
+All collectives are XLA ``ppermute`` on the mesh axis (ICI), differentiable
+(transpose is the reverse rotation), so the same code path trains.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _rotate(x: jax.Array, axis_name: str) -> jax.Array:
+    n = lax.axis_size(axis_name)
+    return lax.ppermute(x, axis_name, [(j, (j + 1) % n) for j in range(n)])
+
+
+def attention_reference(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False
+) -> jax.Array:
+    """Plain full attention ([B, L, H, D] layout) — the numerics oracle."""
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        lq, lk = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((lq, lk), bool), lk - lq)
+        scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: Optional[str] = None,
+    causal: bool = False,
+) -> jax.Array:
+    """Blockwise attention with K/V ring rotation over ``axis_name``.
+
+    Inputs are the LOCAL sequence shards ``[B, L_local, H, D]`` (inside
+    shard_map over the ``sp`` axis); the output is the local shard of the
+    full-attention result.  With ``axis_name=None`` (or outside shard_map)
+    it degrades to exact single-device attention.
+    """
+    if axis_name is None:
+        return attention_reference(q, k, v, causal=causal)
+
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    scale = d**-0.5
+    q_pos = my * lq + jnp.arange(lq)  # global positions of local queries
+
+    def accumulate(acc, src, k_blk, v_blk):
+        o, m, l = acc
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) * scale
+        if causal:
+            kv_pos = src * lk + jnp.arange(lk)
+            mask = q_pos[:, None] >= kv_pos[None, :]  # [lq, lk]
+            scores = jnp.where(mask[None, None], scores, -jnp.inf)
+        m_blk = jnp.max(scores, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        # Fully-masked rows keep m=-inf; guard the exp against inf-inf.
+        safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(scores - safe_m[..., None])
+        corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        o_new = o * corr[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v_blk)
+        return o_new, m_new, l_new
+
+    # Block 0 is the locally-held K/V; the scan then performs exactly n-1
+    # rotations (rotate-then-accumulate), so no transferred block is wasted.
+    o0 = jnp.zeros((b, h, lq, d), jnp.float32)
+    m0 = jnp.full((b, h, lq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, lq), jnp.float32)
+    acc = accumulate(
+        (o0, m0, l0), my, k.astype(jnp.float32), v.astype(jnp.float32)
+    )
+
+    def step(carry, i):
+        acc, k_blk, v_blk = carry
+        k_blk = _rotate(k_blk, axis_name)
+        v_blk = _rotate(v_blk, axis_name)
+        acc = accumulate(acc, (my - i) % n, k_blk, v_blk)
+        return (acc, k_blk, v_blk), None
+
+    if n > 1:
+        (acc, _, _), _ = lax.scan(
+            step,
+            (acc, k.astype(jnp.float32), v.astype(jnp.float32)),
+            jnp.arange(1, n),
+        )
+    o, m, l = acc
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)  # [B, Lq, H, D]

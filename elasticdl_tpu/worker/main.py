@@ -25,7 +25,12 @@ from elasticdl_tpu.data.reader import (
     CompositeDataReader,
     create_data_reader,
 )
-from elasticdl_tpu.worker.worker import RpcMasterProxy, Worker
+from elasticdl_tpu.worker.worker import (
+    RESTART_EXIT_CODE,
+    RpcMasterProxy,
+    Worker,
+    WorkerRestartRequired,
+)
 
 logger = get_logger("worker.main")
 
@@ -58,10 +63,28 @@ def main(argv: Optional[List[str]] = None) -> int:
     worker_id = os.environ.get("ELASTICDL_WORKER_ID", f"worker-{os.getpid()}")
 
     master = RpcMasterProxy(config.master_addr)
+    if config.multihost:  # pragma: no cover - needs real multi-host
+        # Join the jax.distributed world BEFORE any jax computation (the
+        # PJRT backend is fixed once created): register over plain gRPC,
+        # derive this process's spec from membership, initialize.
+        from elasticdl_tpu.parallel import distributed
+
+        membership = master.call(
+            "RegisterWorker",
+            {"worker_id": worker_id, "address": distributed.advertised_address()},
+        )
+        spec = distributed.spec_from_membership(
+            membership, worker_id, config.coordinator_port
+        )
+        distributed.initialize(spec)
     worker = Worker(
         config, master, build_job_reader(config), worker_id=worker_id
     )
-    result = worker.run()
+    try:
+        result = worker.run()
+    except WorkerRestartRequired as e:
+        logger.info("worker %s restarting: %s", worker_id, e)
+        return RESTART_EXIT_CODE
     logger.info("worker %s finished: %s", worker_id, result)
     return 0
 

@@ -73,6 +73,16 @@ def _minibatches(
         yield chunk, true_count
 
 
+class WorkerRestartRequired(RuntimeError):
+    """Raised when an elastic membership change needs a process restart
+    (multihost mode: the jax.distributed world is fixed per process).  The
+    worker main exits with RESTART_EXIT_CODE; the pod manager relaunches
+    without consuming the failure budget."""
+
+
+RESTART_EXIT_CODE = 3
+
+
 class Worker:
     def __init__(
         self,
@@ -113,12 +123,33 @@ class Worker:
     def _mesh_size(self, world_size: int) -> int:
         return max(1, min(world_size * self._dpw, len(self._pool)))
 
+    def _advertised_address(self) -> str:
+        if not self.config.multihost:
+            return ""
+        from elasticdl_tpu.parallel.distributed import advertised_address
+
+        return advertised_address()
+
     def _apply_membership(self, membership: dict, initial: bool = False) -> None:
         version = membership["version"]
         if version == self._membership_version:
             return
         world = max(membership["world_size"], 1)
         self._rank = membership["ranks"].get(self.worker_id, 0)
+        if self.config.multihost and not initial:
+            # The jax.distributed world is fixed per process (PJRT can't be
+            # re-formed in-process): snapshot, then restart.  The pod
+            # manager relaunches RESTART exits without burning the relaunch
+            # budget; the fresh process joins the new world at startup and
+            # resumes from the checkpoint (the reference's elastic-Horovod
+            # re-rendezvous, done the process way).
+            if self._ckpt is not None and self._rank == 0 and self.state is not None:
+                self._ckpt.save(
+                    int(self.state.step), jax.device_get(self.state), wait=True
+                )
+            raise WorkerRestartRequired(
+                f"membership v{version}: world changed to {world} hosts"
+            )
         mesh = create_mesh(self._pool, num_devices=self._mesh_size(world))
         if initial or self.trainer is None:
             self.trainer = Trainer(self.spec, self.config, mesh)
@@ -238,7 +269,10 @@ class Worker:
     # ---- main loop ----
 
     def run(self) -> Dict[str, Any]:
-        membership = self.master.call("RegisterWorker", {"worker_id": self.worker_id})
+        membership = self.master.call(
+            "RegisterWorker",
+            {"worker_id": self.worker_id, "address": self._advertised_address()},
+        )
         self._apply_membership(membership, initial=True)
         if self.state is None:
             self.state = self.trainer.init_state(jax.random.key(0))

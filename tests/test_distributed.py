@@ -1,0 +1,104 @@
+"""Multi-host backend plumbing: membership-derived DistributedSpec, address
+registration through the rendezvous, single-host no-op behavior
+(SURVEY.md §5 distributed comm backend).  Real multi-process
+jax.distributed needs multiple hosts; these tests pin the control-plane
+contract that feeds it."""
+
+from elasticdl_tpu.master.rendezvous import RendezvousServer
+from elasticdl_tpu.parallel.distributed import (
+    DistributedSpec,
+    initialize,
+    spec_from_membership,
+)
+
+
+def test_spec_from_membership_multihost():
+    membership = {
+        "version": 3,
+        "ranks": {"w-a": 0, "w-b": 1, "w-c": 2},
+        "world_size": 3,
+        "addresses": {"w-a": "10.0.0.1", "w-b": "10.0.0.2", "w-c": "10.0.0.3"},
+    }
+    spec = spec_from_membership(membership, "w-b", coordinator_port=9000)
+    assert spec.enabled
+    assert spec.coordinator_address == "10.0.0.1:9000"
+    assert spec.num_processes == 3
+    assert spec.process_id == 1
+
+
+def test_spec_single_host_disabled():
+    membership = {"ranks": {"w-a": 0}, "addresses": {"w-a": "10.0.0.1"}}
+    assert not spec_from_membership(membership, "w-a").enabled
+    # no addresses advertised -> single-host mode regardless of world size
+    membership = {"ranks": {"w-a": 0, "w-b": 1}, "addresses": {}}
+    assert not spec_from_membership(membership, "w-a").enabled
+
+
+def test_spec_missing_rank0_address_disabled():
+    membership = {
+        "ranks": {"w-a": 0, "w-b": 1},
+        "addresses": {"w-b": "10.0.0.2"},
+    }
+    assert not spec_from_membership(membership, "w-b").enabled
+
+
+def test_initialize_noop_for_single_process():
+    # must not touch jax.distributed for a disabled spec
+    initialize(DistributedSpec("", 1, 0))
+
+
+def test_rendezvous_tracks_addresses():
+    rdv = RendezvousServer()
+    rdv.register("w-b", address="10.0.0.2")
+    rdv.register("w-a", address="10.0.0.1")
+    m = rdv.membership()
+    assert m["addresses"] == {"w-a": "10.0.0.1", "w-b": "10.0.0.2"}
+    assert m["ranks"] == {"w-a": 0, "w-b": 1}
+    rdv.remove("w-a")
+    m = rdv.membership()
+    assert m["addresses"] == {"w-b": "10.0.0.2"}
+
+
+def test_rendezvous_address_change_bumps_version():
+    """A worker restarted on a new host must be re-discovered: same id,
+    new address -> version bump so peers re-read membership."""
+    rdv = RendezvousServer()
+    v1 = rdv.register("w-a", address="10.0.0.1")
+    assert rdv.register("w-a", address="10.0.0.1") == v1  # no spurious bump
+    v2 = rdv.register("w-a", address="10.0.0.9")
+    assert v2 > v1
+    assert rdv.membership()["addresses"]["w-a"] == "10.0.0.9"
+
+
+def test_pod_manager_restart_exit_is_budget_free():
+    """Exit code 3 (multihost re-join restart) relaunches the slot without
+    consuming the relaunch budget."""
+    from elasticdl_tpu.common.config import JobConfig
+    from elasticdl_tpu.master.pod_manager import (
+        FakePodBackend,
+        PodManager,
+        PodPhase,
+    )
+
+    backend = FakePodBackend()
+    config = JobConfig(max_worker_relaunch=1)
+    mgr = PodManager(backend, config)
+    mgr.start(1)
+    for _ in range(4):  # far beyond the budget of 1
+        [name] = mgr.live_pods()
+        backend.set_phase(name, PodPhase.RESTART)
+    [survivor] = mgr.live_pods()
+    assert mgr.pod_info(survivor).relaunches == 0
+    # a real failure still consumes budget afterwards
+    backend.fail_pod(survivor)
+    [relaunched] = mgr.live_pods()
+    assert mgr.pod_info(relaunched).relaunches == 1
+
+
+def test_rendezvous_reap_clears_addresses():
+    t = [0.0]
+    rdv = RendezvousServer(heartbeat_timeout_s=5.0, clock=lambda: t[0])
+    rdv.register("w-a", address="10.0.0.1")
+    t[0] = 10.0
+    assert rdv.reap_dead() == ["w-a"]
+    assert rdv.membership()["addresses"] == {}
