@@ -347,7 +347,31 @@ class KubernetesPodBackend(PodBackend):
                     timeout_seconds=30,
                 ):
                     pod = event["object"]
-                    self._emit(pod.metadata.name, pod.status.phase)
+                    phase = pod.status.phase
+                    if phase == PodPhase.FAILED:
+                        # k8s has no 'Restart' phase: a worker exiting with
+                        # WORKER_RESTART_EXIT_CODE (multihost elastic re-join)
+                        # shows as Failed.  Map it back to RESTART from the
+                        # container's terminated exit code so membership
+                        # changes don't consume the slot's relaunch budget.
+                        try:
+                            statuses = pod.status.container_statuses or []
+                            term = (
+                                statuses[0].state.terminated
+                                if statuses and statuses[0].state
+                                else None
+                            )
+                            if (
+                                term is not None
+                                and term.exit_code == WORKER_RESTART_EXIT_CODE
+                            ):
+                                phase = PodPhase.RESTART
+                        except Exception:
+                            logger.exception(
+                                "could not read exit code of failed pod %s",
+                                pod.metadata.name,
+                            )
+                    self._emit(pod.metadata.name, phase)
             except Exception:
                 # watch.stream raises routinely (410 Gone on resourceVersion
                 # expiry, transient apiserver errors); re-establish the watch
@@ -430,11 +454,54 @@ class PodManager:
                 if info is not None and info.phase not in PodPhase.TERMINAL:
                     to_delete.append(info.name)
         for info in to_start:
-            self._backend.start_pod(info.name, self._pod_env(info))
+            self._launch(info)
         for name in to_delete:
             self._backend.delete_pod(name)
         if n != old:
             logger.info("scaled worker fleet %d -> %d", old, n)
+
+    # How many times a single pod launch is retried against backend errors
+    # (transient k8s API outages, fork failures) before the failure is
+    # surfaced as a budget-consuming FAILED event.  The backoff schedule
+    # (1+2+4+8+16+30+30 = ~91s) outlasts a ~1-minute apiserver outage.
+    MAX_START_ATTEMPTS = 8
+
+    def _launch(self, info: PodInfo, attempt: int = 0) -> None:
+        """start_pod with bounded backoff retries for the SAME PodInfo.
+
+        A launch that throws is retried directly — NOT turned into a FAILED
+        pod event — so a ~1-minute transient k8s API outage doesn't eat the
+        slot's relaunch budget (and budget-free RESTART relaunches stay
+        budget-free).  Only after MAX_START_ATTEMPTS does it degrade to the
+        normal failure path.
+        """
+        with self._lock:
+            if self._slots.get(info.slot) is not info:
+                return  # slot was scaled away or superseded while backing off
+        try:
+            self._backend.start_pod(info.name, self._pod_env(info))
+        except Exception:
+            logger.exception(
+                "launch of %s failed (attempt %d/%d)",
+                info.name, attempt + 1, self.MAX_START_ATTEMPTS,
+            )
+            if attempt + 1 >= self.MAX_START_ATTEMPTS:
+                self._on_event(info.name, PodPhase.FAILED)
+                return
+            delay = min(2.0 ** attempt, 30.0)
+            timer = threading.Timer(delay, self._launch, (info, attempt + 1))
+            timer.daemon = True
+            with self._lock:
+                # Prune timers that already fired or were cancelled so the
+                # list stays bounded.  `finished` (set after run or cancel)
+                # is the right predicate: is_alive() is also False for
+                # appended-but-not-yet-started timers, which must stay
+                # cancellable by stop().
+                self._retry_timers = [
+                    t for t in self._retry_timers if not t.finished.is_set()
+                ]
+                self._retry_timers.append(timer)
+            timer.start()
 
     def _new_pod_locked(self, slot: int, relaunches: int) -> PodInfo:
         gen = self._slot_gen.get(slot, -1) + 1
@@ -513,35 +580,10 @@ class PodManager:
                 name, relaunch_info.name,
                 relaunch_info.relaunches, self._max_relaunch,
             )
-            try:
-                self._backend.start_pod(
-                    relaunch_info.name, self._pod_env(relaunch_info)
-                )
-            except Exception:
-                # A failed relaunch (OSError under memory pressure, transient
-                # k8s API error, ...) must not unwind into the backend's
-                # watcher thread — that would kill the only thread observing
-                # pod events and freeze elasticity.  Schedule the next
-                # attempt after a backoff: instant retries would burn the
-                # slot's whole relaunch budget before any transient condition
-                # could clear.
-                logger.exception("relaunch of %s failed", relaunch_info.name)
-                delay = min(2.0 ** relaunch_info.relaunches, 30.0)
-                timer = threading.Timer(
-                    delay, self._on_event, (relaunch_info.name, PodPhase.FAILED)
-                )
-                timer.daemon = True
-                with self._lock:
-                    # Prune timers that already fired or were cancelled so
-                    # the list stays bounded.  `finished` (set after run or
-                    # cancel) is the right predicate: is_alive() is also
-                    # False for appended-but-not-yet-started timers, which
-                    # must stay cancellable by stop().
-                    self._retry_timers = [
-                        t for t in self._retry_timers if not t.finished.is_set()
-                    ]
-                    self._retry_timers.append(timer)
-                timer.start()
+            # _launch retries transient backend errors for this same PodInfo
+            # without unwinding into the watcher thread (the only thread
+            # observing pod events) and without consuming relaunch budget.
+            self._launch(relaunch_info)
 
     # -- introspection --
 

@@ -108,6 +108,7 @@ class Worker:
         self.state = None
         self._membership_version = -1
         self._rank = 0
+        self._ranks: Dict[str, int] = {}
         self._ckpt: Optional[CheckpointManager] = None
         self._last_ckpt_step = 0
         self.reforms = 0  # elastic mesh re-formations (observability/tests)
@@ -135,7 +136,9 @@ class Worker:
         if version == self._membership_version:
             return
         world = max(membership["world_size"], 1)
-        self._rank = membership["ranks"].get(self.worker_id, 0)
+        prev_ranks = self._ranks
+        self._ranks = dict(membership["ranks"])
+        self._rank = self._ranks.get(self.worker_id, 0)
         if self.config.multihost and not initial:
             # The jax.distributed world is fixed per process (PJRT can't be
             # re-formed in-process): snapshot, then restart.  The pod
@@ -143,7 +146,21 @@ class Worker:
             # budget; the fresh process joins the new world at startup and
             # resumes from the checkpoint (the reference's elastic-Horovod
             # re-rendezvous, done the process way).
-            if self._ckpt is not None and self._rank == 0 and self.state is not None:
+            #
+            # The snapshot must come from a SURVIVOR of the previous
+            # membership — a newly joined worker can take new-rank 0 with no
+            # state, and gating on new rank would then silently lose all
+            # progress since the last periodic checkpoint.  The lowest
+            # previous-rank worker still present in the new membership saves.
+            survivors = set(prev_ranks) & set(self._ranks)
+            saver = (
+                min(survivors, key=lambda w: prev_ranks[w]) if survivors else None
+            )
+            if (
+                self._ckpt is not None
+                and self.worker_id == saver
+                and self.state is not None
+            ):
                 self._ckpt.save(
                     int(self.state.step), jax.device_get(self.state), wait=True
                 )
