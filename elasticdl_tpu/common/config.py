@@ -110,6 +110,11 @@ class JobConfig:
     # --- precision ---
     compute_dtype: str = "bfloat16"  # MXU-native; params stay f32
 
+    # --- sharded embedding lookup route (ops.embedding) ---
+    # auto = ragged all-to-all on TPU meshes, dense (all_gather+psum_scatter)
+    # on CPU; ragged_emulated exists for CPU tests of the ragged routing.
+    embedding_lookup_impl: str = "auto"
+
     def validate(self) -> None:
         if self.distribution_strategy not in DistributionStrategy.ALL:
             raise ValueError(
@@ -126,6 +131,15 @@ class JobConfig:
             raise ValueError(
                 f"--pod_backend must be process|kubernetes|fake, got "
                 f"{self.pod_backend!r}"
+            )
+        # Kept in sync with ops.embedding.LOOKUP_IMPLS (asserted by tests);
+        # not imported from there so this module stays jax-free (the master
+        # control plane and pod manager must run without jax).
+        impls = ("auto", "ragged", "ragged_emulated", "dense")
+        if self.embedding_lookup_impl not in impls:
+            raise ValueError(
+                f"--embedding_lookup_impl must be one of {impls}, got "
+                f"{self.embedding_lookup_impl!r}"
             )
 
     # -- serialization: the config bus between master and worker pods --

@@ -23,17 +23,39 @@ Design (static shapes, XLA/ICI-friendly — see SURVEY.md §7 item 5):
   natural div-sharding of the 1-D array, so the same array is addressable
   both outside shard_map (one logical array, e.g. for Orbax) and inside (the
   local row range).
-- Forward, per device: ``all_gather`` every device's ids (tiny int32
-  traffic), slice-gather the rows this shard owns (masked, uniform compute —
-  load is balanced regardless of id distribution), then ``psum_scatter`` the
-  vectors so each device receives exactly its own batch's embeddings, summed
-  across shards (exactly one shard contributed each row).  Vector traffic
-  crosses ICI once — the same volume a ragged all-to-all would move.
-- Backward is pure JAX AD: the transpose of ``psum_scatter`` is
-  ``all_gather`` of the cotangents and the transpose of the slice gather is
-  a contiguous scatter-add into the local shard — the moral equivalent of
-  the reference's server-side IndexedSlices apply, with duplicate ids
-  correctly accumulated.
+
+Two collective lookup implementations, selected at trace time:
+
+- ``ragged`` (default on TPU) — the north-star **ragged all-to-all** route:
+  sort local ids by owner shard, exchange per-destination counts (n² int32),
+  ``lax.ragged_all_to_all`` the ids to their owners, slice-gather locally,
+  ``lax.ragged_all_to_all`` the vectors straight back, unsort.  Each vector
+  crosses ICI exactly once, so per-device vector traffic is ~``B_local·dim``
+  (id-distribution dependent), independent of mesh size.  XLA:CPU does not
+  implement the ``ragged-all-to-all`` HLO, so tests exercise the identical
+  routing/offset/unsort code through a dense all_gather emulation of the
+  collective (``ragged_emulated``) that is semantically equivalent by
+  construction.
+- ``dense`` (CPU fallback; also the n=1 degenerate) — ``all_gather`` every
+  device's ids, masked slice-gather over the full global id list, then
+  ``psum_scatter`` a ``[n·B_local, dim]`` array so each device receives its
+  own rows.  Simple and always available, but the psum_scatter moves
+  ~``(n-1)·B_local·dim`` per device — ~(n−1)× the ragged route's vector
+  volume — so it loses badly at pod scale.
+
+Backward (both impls): the cotangents retrace the forward route back to the
+owner shard and scatter-add into its local rows (contiguous flat scatter —
+the transpose of the slice gather), with duplicate ids correctly accumulated
+— the moral equivalent of the reference's server-side IndexedSlices apply.
+The ragged impl does this through a ``custom_vjp`` (the ragged collective has
+no AD rule): the saved routing metadata is replayed, vectors flow
+requester→owner, and the owner applies the same masked scatter-add.
+
+Fail-loud OOV contract (both impls): an id outside the padded global vocab
+comes back as a NaN row — never a silently wrong or zero row.  In the ragged
+impl this is structural: the junk id routes to a clamped owner whose local
+row range it misses, the FILL_OR_DROP gather fills NaN, and the NaN rides
+back to the requester; its cotangent is dropped on the same grounds.
 
 Optimizer state for the table is co-sharded automatically because optax maps
 leaf-wise (each shard's Adam moments live next to its rows — like the
@@ -43,10 +65,12 @@ reference's per-PS-pod Go optimizer state).
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 # Pad vocabularies to a multiple of this so the padded size divides every
@@ -58,6 +82,13 @@ _GATHER_DNUMS = lax.GatherDimensionNumbers(
     offset_dims=(1,), collapsed_slice_dims=(), start_index_map=(0,)
 )
 
+#: Lookup implementations (ParallelContext.embedding_impl / config flag).
+IMPL_AUTO = "auto"
+IMPL_RAGGED = "ragged"
+IMPL_RAGGED_EMULATED = "ragged_emulated"  # tests: same routing, dense collective
+IMPL_DENSE = "dense"
+LOOKUP_IMPLS = (IMPL_AUTO, IMPL_RAGGED, IMPL_RAGGED_EMULATED, IMPL_DENSE)
+
 
 @dataclasses.dataclass(frozen=True)
 class ParallelContext:
@@ -66,11 +97,14 @@ class ParallelContext:
     Passed by the trainer into ``ModelSpec.apply`` so embedding ops know
     whether tables are mesh-sharded (ParameterServer strategy) or replicated
     (AllReduce/Local).  ``axis_name`` is the mesh axis the step runs under
-    (None when not inside shard_map).
+    (None when not inside shard_map).  ``embedding_impl`` picks the sharded
+    lookup route; ``auto`` resolves to ragged on TPU meshes and dense
+    elsewhere (the trainer resolves it before tracing).
     """
 
     axis_name: Optional[str] = None
     sharded_embeddings: bool = False
+    embedding_impl: str = IMPL_AUTO
 
 
 def pad_vocab(vocab_size: int, multiple: int = DEFAULT_VOCAB_MULTIPLE) -> int:
@@ -104,11 +138,18 @@ def gather_rows(flat_table: jax.Array, ids: jax.Array, dim: int) -> jax.Array:
 
     Contiguous-slice gather; its AD transpose is a contiguous scatter-add.
     Out-of-range ids fill with NaN (floats) so id-generation bugs surface
-    immediately instead of silently training on a clamped row; the sharded
-    path returns zeros for the same bug (no shard owns the row).  The
+    immediately instead of silently training on a clamped row.  The
     FILL_OR_DROP transpose likewise drops OOB cotangents.
     """
-    starts = (ids.reshape(-1, 1) * dim).astype(jnp.int32)
+    # Mark out-of-range ids BEFORE the ``* dim`` scaling: a junk id large
+    # enough to overflow int32 in ``id * dim`` could wrap back into range and
+    # silently gather a wrong row, breaking the NaN-fill guarantee.  Rows
+    # outside [0, num_rows) get an explicitly OOB start (the flat length), so
+    # FILL_OR_DROP always sees them as out of bounds.
+    num_rows = flat_table.shape[0] // dim
+    ids_flat = ids.reshape(-1, 1)
+    oob = (ids_flat < 0) | (ids_flat >= num_rows)
+    starts = jnp.where(oob, flat_table.shape[0], ids_flat * dim).astype(jnp.int32)
     out = lax.gather(
         flat_table,
         starts,
@@ -149,21 +190,53 @@ def embedding_lookup(
 
     if not (ctx.sharded_embeddings and ctx.axis_name):
         return gather_rows(flat, ids, dim)
-    return _sharded_lookup(flat, ids, ctx.axis_name, dim)
+    impl = resolve_impl(ctx.embedding_impl)
+    # n=1 degenerates to a local gather (dense short-circuits it); an
+    # EXPLICIT ragged request is still honored so the real op can be
+    # smoke-tested on a single chip.
+    if impl == IMPL_DENSE or (
+        lax.axis_size(ctx.axis_name) == 1 and impl == IMPL_RAGGED_EMULATED
+    ):
+        return _dense_lookup(flat, ids, ctx.axis_name, dim)
+    return _ragged_lookup(
+        flat, ids, ctx.axis_name, dim, impl == IMPL_RAGGED_EMULATED
+    )
 
 
-def _sharded_lookup(local_flat: jax.Array, ids: jax.Array, axis_name: str, dim: int):
+def resolve_impl(impl: str, platform: Optional[str] = None) -> str:
+    """Resolve ``auto`` to a concrete impl for ``platform`` (default: the
+    current default backend).  XLA:CPU has no ragged-all-to-all HLO, so auto
+    means dense there; on TPU it means the ragged route."""
+    if impl not in LOOKUP_IMPLS:
+        raise ValueError(f"unknown embedding lookup impl {impl!r}")
+    if impl != IMPL_AUTO:
+        return impl
+    platform = platform or jax.default_backend()
+    return IMPL_RAGGED if platform == "tpu" else IMPL_DENSE
+
+
+# ---------------------------------------------------------------------------
+# dense route: all_gather ids -> masked local gather -> psum_scatter vectors
+# ---------------------------------------------------------------------------
+
+
+def _dense_lookup(local_flat: jax.Array, ids: jax.Array, axis_name: str, dim: int):
     n = lax.axis_size(axis_name)
     my_shard = lax.axis_index(axis_name)
     rows_local = local_flat.shape[0] // dim
 
     ids_shape = ids.shape
-    # [n, local_ids] — every device's flat id list.
-    all_ids = lax.all_gather(ids.reshape(-1), axis_name)
-    flat_ids = all_ids.reshape(-1)
+    flat_ids = ids.reshape(-1)
+    bad = (flat_ids < 0) | (flat_ids >= n * rows_local)
+    if n == 1:
+        out = gather_rows(local_flat, flat_ids, dim)  # NaN-fills OOB itself
+        return out.reshape(ids_shape + (dim,))
 
-    owner = flat_ids // rows_local
-    local_row = flat_ids - owner * rows_local
+    # [n * local_ids] — every device's flat id list.
+    all_ids = lax.all_gather(flat_ids, axis_name).reshape(-1)
+
+    owner = all_ids // rows_local
+    local_row = all_ids - owner * rows_local
     mine = owner == my_shard
     safe_row = jnp.where(mine, local_row, 0)
     vectors = jnp.where(mine[:, None], gather_rows(local_flat, safe_row, dim), 0)
@@ -171,4 +244,155 @@ def _sharded_lookup(local_flat: jax.Array, ids: jax.Array, axis_name: str, dim: 
     # Route each device its own block, summing over shards (one nonzero each).
     vectors = vectors.reshape(n, -1, dim)
     out = lax.psum_scatter(vectors, axis_name, scatter_dimension=0, tiled=False)
+    # Fail-loud OOV: an id owned by NO shard summed to zeros above; surface
+    # it as NaN to match gather_rows' single-device contract.
+    out = jnp.where(bad[:, None], jnp.nan, out)
     return out.reshape(ids_shape + (dim,))
+
+
+# ---------------------------------------------------------------------------
+# ragged route: sort by owner -> ragged all-to-all ids -> local gather ->
+# ragged all-to-all vectors back -> unsort        (custom_vjp: retrace route)
+# ---------------------------------------------------------------------------
+
+
+def _ragged_collective(operand, output, in_off, send, out_off, recv, axis_name,
+                       emulate: bool):
+    """``lax.ragged_all_to_all`` or a semantically-identical dense emulation.
+
+    The emulation exists because XLA:CPU lacks the ragged-all-to-all HLO: it
+    all_gathers every device's operand and offset metadata, then each device
+    assembles its output buffer position-by-position from the senders' chunks
+    — exactly the op's documented placement semantics (chunk ``j`` of device
+    ``k``'s operand, ``[in_off[j], +send[j])``, lands in device ``j``'s output
+    at ``[out_off[j], +send[j])``).  O(n·len(output)) masks — test-only.
+    """
+    if not emulate:
+        return lax.ragged_all_to_all(
+            operand, output,
+            in_off.astype(jnp.int32), send.astype(jnp.int32),
+            out_off.astype(jnp.int32), recv.astype(jnp.int32),
+            axis_name=axis_name,
+        )
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    ops = lax.all_gather(operand, axis_name)          # [n, L, ...]
+    IN = lax.all_gather(in_off, axis_name)            # [n, n] sender-major
+    SE = lax.all_gather(send, axis_name)              # [n, n]
+    OUT = lax.all_gather(out_off, axis_name)          # [n, n]
+    L_out = output.shape[0]
+    pos = jnp.arange(L_out)
+    # For sender k, its chunk to me sits at my [OUT[k,me], +SE[k,me]).
+    start = OUT[:, me][:, None]                       # [n, 1]
+    size = SE[:, me][:, None]
+    src0 = IN[:, me][:, None]
+    inside = (pos[None, :] >= start) & (pos[None, :] < start + size)  # [n, L_out]
+    k_of = jnp.argmax(inside, axis=0)                 # sender for each position
+    valid = jnp.any(inside, axis=0)
+    src = src0[k_of, 0] + pos - start[k_of, 0]
+    flat_src = k_of * ops.shape[1] + jnp.clip(src, 0, ops.shape[1] - 1)
+    picked = ops.reshape((-1,) + ops.shape[2:])[flat_src]
+    mask = valid.reshape((-1,) + (1,) * (output.ndim - 1))
+    return jnp.where(mask, picked, output)
+
+
+def _routing_plan(ids: jax.Array, axis_name: str, rows_local: int):
+    """Per-device routing metadata for the ragged route.
+
+    Returns (perm, sorted_ids, send_sizes, in_off, out_off, recv_sizes,
+    back_out_off).  ``S[k, j]`` (how many ids device k sends to shard j) is
+    shared via one tiny [n, n] int32 all_gather; every offset both directions
+    derives from it, so forward and backward use one consistent plan.
+    """
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    # Junk ids get a clamped owner; their original value then misses that
+    # owner's row range and NaN-fills (fail-loud OOV, see module docstring).
+    owner = jnp.clip(ids // rows_local, 0, n - 1)
+    perm = jnp.argsort(owner)
+    sorted_ids = ids[perm]
+    send_sizes = jnp.bincount(owner, length=n).astype(jnp.int32)
+    in_off = _exclusive_cumsum(send_sizes)
+    S = lax.all_gather(send_sizes, axis_name)          # [n, n]
+    recv_sizes = S[:, me]
+    # Where my chunk starts in shard j's recv buffer: senders before me.
+    before_me = (jnp.arange(n) < me)[:, None]
+    out_off = jnp.sum(jnp.where(before_me, S, 0), axis=0).astype(jnp.int32)
+    # Where shard j's RETURN chunk starts in my [L] buffer: my ids are sorted
+    # by owner, so it's my in_off — but computed on j's side it must be the
+    # same value; return routing reuses in_off/out_off with roles swapped.
+    return perm, sorted_ids, send_sizes, in_off, out_off, recv_sizes, S
+
+
+def _exclusive_cumsum(x: jax.Array) -> jax.Array:
+    return jnp.concatenate(
+        [jnp.zeros((1,), x.dtype), jnp.cumsum(x)[:-1].astype(x.dtype)]
+    )
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _ragged_lookup(local_flat, ids, axis_name: str, dim: int, emulate: bool):
+    out, _ = _ragged_lookup_fwd(local_flat, ids, axis_name, dim, emulate)
+    return out
+
+
+def _ragged_lookup_fwd(local_flat, ids, axis_name: str, dim: int, emulate: bool):
+    n = lax.axis_size(axis_name)
+    rows_local = local_flat.shape[0] // dim
+    ids_shape = ids.shape
+    flat_ids = ids.reshape(-1)
+    L = flat_ids.shape[0]
+
+    (perm, sorted_ids, send, in_off, out_off, recv, S) = _routing_plan(
+        flat_ids, axis_name, rows_local
+    )
+    # ids -> owners.  Buffer statically sized n*L (worst-case skew: every
+    # shard's batch hits my rows); -1 padding = OOB = NaN row if ever read.
+    id_buf = jnp.full((n * L,), -1, dtype=flat_ids.dtype)
+    recv_ids = _ragged_collective(
+        sorted_ids, id_buf, in_off, send, out_off, recv, axis_name, emulate
+    )
+    local_rows = recv_ids - lax.axis_index(axis_name) * rows_local
+    vecs = gather_rows(local_flat, local_rows, dim)    # [n*L, dim], NaN on OOB
+
+    # vectors -> requesters: exactly the reverse plan.  My block offsets are
+    # recv's exclusive cumsum (received chunks are sender-ordered); my chunk
+    # lands back where requester j's sorted block for me starts — j's in_off
+    # for me, which is S[j, :me].sum() row-wise.
+    me = lax.axis_index(axis_name)
+    back_in_off = _exclusive_cumsum(recv)
+    before = (jnp.arange(n) < me)[None, :]
+    back_out_off = jnp.sum(jnp.where(before, S, 0), axis=1).astype(jnp.int32)
+    vec_buf = jnp.zeros((L, dim), vecs.dtype)
+    sorted_out = _ragged_collective(
+        vecs, vec_buf, back_in_off, recv, back_out_off, send, axis_name, emulate
+    )
+    inv = jnp.zeros_like(perm).at[perm].set(jnp.arange(L))
+    out = sorted_out[inv].reshape(ids_shape + (dim,))
+    residuals = (perm, send, in_off, out_off, recv, back_in_off, back_out_off,
+                 local_rows, local_flat.shape[0], ids_shape)
+    return out, residuals
+
+
+def _ragged_lookup_bwd(axis_name: str, dim: int, emulate: bool, residuals, g):
+    (perm, send, in_off, out_off, recv, back_in_off, back_out_off,
+     local_rows, flat_len, ids_shape) = residuals
+    n = lax.axis_size(axis_name)
+    L = perm.shape[0]
+    # Cotangents retrace the forward id route (requester -> owner): sort by
+    # owner, ragged a2a with the SAME plan, then contiguous scatter-add into
+    # the local shard.  Stale buffer slots hold local_rows=-1 (OOB), so
+    # FILL_OR_DROP's transpose drops them — as it drops junk-id cotangents.
+    g_sorted = g.reshape(L, dim)[perm]
+    g_buf = jnp.zeros((n * L, dim), g_sorted.dtype)
+    g_at_owner = _ragged_collective(
+        g_sorted, g_buf, in_off, send, out_off, recv, axis_name, emulate
+    )
+    zeros = jnp.zeros((flat_len,), g_at_owner.dtype)
+    _, pull = jax.vjp(lambda t: gather_rows(t, local_rows, dim), zeros)
+    (table_bar,) = pull(g_at_owner)
+    ids_bar = np.zeros(ids_shape, jax.dtypes.float0)
+    return table_bar, ids_bar
+
+
+_ragged_lookup.defvjp(_ragged_lookup_fwd, _ragged_lookup_bwd)

@@ -37,7 +37,7 @@ from jax.sharding import PartitionSpec as P
 
 from elasticdl_tpu.common.config import DistributionStrategy, JobConfig
 from elasticdl_tpu.models.spec import EmbeddingTableSpec, ModelSpec
-from elasticdl_tpu.ops.embedding import ParallelContext, pad_vocab
+from elasticdl_tpu.ops.embedding import ParallelContext, pad_vocab, resolve_impl
 
 try:  # jax >= 0.6 exports shard_map at top level
     shard_map = jax.shard_map  # type: ignore[attr-defined]
@@ -141,13 +141,24 @@ class Trainer:
             config.distribution_strategy == DistributionStrategy.PARAMETER_SERVER
             and bool(spec.embedding_tables)
         )
-        self.ctx = ParallelContext(
-            axis_name=self.axis_name, sharded_embeddings=self.sharded_embeddings
-        )
+        self.ctx = self._make_ctx()
         self._state_specs = None
         self._train_step = None
         self._eval_step = None
         self._predict_step = None
+
+    def _make_ctx(self) -> ParallelContext:
+        # Resolve "auto" against the MESH's platform (not the default
+        # backend): tests build CPU meshes in a process whose default backend
+        # may be TPU, and the ragged-all-to-all HLO only exists on TPU.
+        platform = self.mesh.devices.flat[0].platform
+        return ParallelContext(
+            axis_name=self.axis_name,
+            sharded_embeddings=self.sharded_embeddings,
+            embedding_impl=resolve_impl(
+                self.config.embedding_lookup_impl, platform
+            ),
+        )
 
     # ---- elastic re-formation ----
 
@@ -159,9 +170,7 @@ class Trainer:
         """
         self.mesh = mesh
         self.axis_name = mesh.axis_names[0]
-        self.ctx = ParallelContext(
-            axis_name=self.axis_name, sharded_embeddings=self.sharded_embeddings
-        )
+        self.ctx = self._make_ctx()
         self._state_specs = None
         self._train_step = None
         self._eval_step = None

@@ -25,14 +25,22 @@ except AttributeError:  # pragma: no cover
 VOCAB = 64  # divisible by 8 so a [V, D] table div-shards cleanly
 DIM = 16
 
+# Both collective lookup routes.  "ragged_emulated" runs the real ragged
+# routing/offset/unsort code with a dense emulation of the ragged-all-to-all
+# collective (XLA:CPU has no ragged-all-to-all HLO; on TPU "auto" resolves to
+# the real op through the identical code path).
+IMPLS = ("dense", "ragged_emulated")
+
 
 def _table(rng):
     return jax.random.normal(rng, (VOCAB, DIM), jnp.float32)
 
 
-def _sharded_fn(mesh, fn):
+def _sharded_fn(mesh, fn, impl="dense"):
     axis = mesh.axis_names[0]
-    ctx = ParallelContext(axis_name=axis, sharded_embeddings=True)
+    ctx = ParallelContext(
+        axis_name=axis, sharded_embeddings=True, embedding_impl=impl
+    )
     return shard_map(
         lambda t, i: fn(t, i, ctx),
         mesh=mesh,
@@ -92,8 +100,9 @@ def test_flat_lookup_dim_validation():
         )
 
 
+@pytest.mark.parametrize("impl", IMPLS)
 @pytest.mark.parametrize("n_dev", [1, 4, 8])
-def test_sharded_flat_lookup_matches_gather(devices, n_dev):
+def test_sharded_flat_lookup_matches_gather(devices, n_dev, impl):
     mesh = create_mesh(devices, num_devices=n_dev)
     axis = mesh.axis_names[0]
     table = _table(jax.random.key(0))
@@ -101,7 +110,9 @@ def test_sharded_flat_lookup_matches_gather(devices, n_dev):
     ids = jax.random.randint(jax.random.key(1), (32,), 0, VOCAB)
     expected = jnp.take(table, ids, axis=0)
 
-    ctx = ParallelContext(axis_name=axis, sharded_embeddings=True)
+    ctx = ParallelContext(
+        axis_name=axis, sharded_embeddings=True, embedding_impl=impl
+    )
     mapped = shard_map(
         lambda t, i: embedding_lookup(t, i, ctx, dim=DIM),
         mesh=mesh,
@@ -114,7 +125,8 @@ def test_sharded_flat_lookup_matches_gather(devices, n_dev):
     np.testing.assert_allclose(np.asarray(out), np.asarray(expected), rtol=1e-6)
 
 
-def test_sharded_flat_gradient_duplicates(devices):
+@pytest.mark.parametrize("impl", IMPLS)
+def test_sharded_flat_gradient_duplicates(devices, impl):
     mesh = create_mesh(devices)
     axis = mesh.axis_names[0]
     table = _table(jax.random.key(0))
@@ -126,7 +138,9 @@ def test_sharded_flat_gradient_duplicates(devices):
         lambda t: jnp.sum(jnp.take(t, ids, axis=0) * cot)
     )(table).reshape(-1)
 
-    ctx = ParallelContext(axis_name=axis, sharded_embeddings=True)
+    ctx = ParallelContext(
+        axis_name=axis, sharded_embeddings=True, embedding_impl=impl
+    )
     mapped = shard_map(
         jax.grad(
             lambda t, i, c: jnp.sum(embedding_lookup(t, i, ctx, dim=DIM) * c)
@@ -141,8 +155,9 @@ def test_sharded_flat_gradient_duplicates(devices):
     np.testing.assert_allclose(np.asarray(grad), np.asarray(expected), rtol=1e-5)
 
 
+@pytest.mark.parametrize("impl", IMPLS)
 @pytest.mark.parametrize("n_dev", [1, 4, 8])
-def test_sharded_lookup_matches_gather(devices, n_dev):
+def test_sharded_lookup_matches_gather(devices, n_dev, impl):
     mesh = create_mesh(devices, num_devices=n_dev)
     table = _table(jax.random.key(0))
     ids = jax.random.randint(jax.random.key(1), (32,), 0, VOCAB)
@@ -151,11 +166,30 @@ def test_sharded_lookup_matches_gather(devices, n_dev):
 
     table_s = jax.device_put(table, NamedSharding(mesh, P(mesh.axis_names[0])))
     ids_s = jax.device_put(ids, NamedSharding(mesh, P(mesh.axis_names[0])))
-    out = jax.jit(_sharded_fn(mesh, embedding_lookup))(table_s, ids_s)
+    out = jax.jit(_sharded_fn(mesh, embedding_lookup, impl))(table_s, ids_s)
     np.testing.assert_allclose(np.asarray(out), np.asarray(expected), rtol=1e-6)
 
 
-def test_sharded_lookup_2d_ids(devices):
+@pytest.mark.parametrize("impl", IMPLS)
+def test_sharded_lookup_skewed_ids(devices, impl):
+    """Worst-case routing skew: every device's ids all live on ONE shard (the
+    ragged route's send sizes are maximally unbalanced)."""
+    mesh = create_mesh(devices)
+    table = _table(jax.random.key(0))
+    rows_per_shard = VOCAB // 8
+    # All 32 ids hit shard 5's row range.
+    ids = jax.random.randint(
+        jax.random.key(3), (32,), 5 * rows_per_shard, 6 * rows_per_shard
+    )
+    expected = jnp.take(table, ids, axis=0)
+    table_s = jax.device_put(table, NamedSharding(mesh, P(mesh.axis_names[0])))
+    ids_s = jax.device_put(ids, NamedSharding(mesh, P(mesh.axis_names[0])))
+    out = jax.jit(_sharded_fn(mesh, embedding_lookup, impl))(table_s, ids_s)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), rtol=1e-6)
+
+
+@pytest.mark.parametrize("impl", IMPLS)
+def test_sharded_lookup_2d_ids(devices, impl):
     """ids shaped [batch, n_features] — the tabular-model case."""
     mesh = create_mesh(devices)
     table = _table(jax.random.key(0))
@@ -164,11 +198,12 @@ def test_sharded_lookup_2d_ids(devices):
     expected = jnp.take(table, ids, axis=0)
     table_s = jax.device_put(table, NamedSharding(mesh, P(mesh.axis_names[0])))
     ids_s = jax.device_put(ids, NamedSharding(mesh, P(mesh.axis_names[0])))
-    out = jax.jit(_sharded_fn(mesh, embedding_lookup))(table_s, ids_s)
+    out = jax.jit(_sharded_fn(mesh, embedding_lookup, impl))(table_s, ids_s)
     np.testing.assert_allclose(np.asarray(out), np.asarray(expected), rtol=1e-6)
 
 
-def test_sharded_lookup_gradient_accumulates_duplicates(devices):
+@pytest.mark.parametrize("impl", IMPLS)
+def test_sharded_lookup_gradient_accumulates_duplicates(devices, impl):
     """d(loss)/d(table) must scatter-ADD cotangents for duplicate ids — the
     reference's IndexedSlices semantics on the PS side."""
     mesh = create_mesh(devices)
@@ -184,7 +219,9 @@ def test_sharded_lookup_gradient_accumulates_duplicates(devices):
 
     expected_grad = jax.grad(ref_loss)(table)
 
-    ctx = ParallelContext(axis_name=axis, sharded_embeddings=True)
+    ctx = ParallelContext(
+        axis_name=axis, sharded_embeddings=True, embedding_impl=impl
+    )
 
     def local_loss(t, i, c):
         # Per-device scalar, NOT psum'd: under AD each device's cotangent is 1,
@@ -204,3 +241,86 @@ def test_sharded_lookup_gradient_accumulates_duplicates(devices):
     sh = lambda a: jax.device_put(a, NamedSharding(mesh, P(axis)))
     grad = jax.jit(mapped)(sh(table), sh(ids), sh(cot))
     np.testing.assert_allclose(np.asarray(grad), np.asarray(expected_grad), rtol=1e-5)
+
+
+@pytest.mark.parametrize("impl", IMPLS)
+def test_sharded_lookup_oov_is_nan(devices, impl):
+    """Fail-loud OOV: ids outside the padded global vocab come back as NaN
+    rows in SHARDED mode too (VERDICT r1 'loud OOV'), never zeros or a
+    silently wrong row; in-range rows are unaffected."""
+    mesh = create_mesh(devices)
+    table = _table(jax.random.key(0))
+    ids = np.random.default_rng(0).integers(0, VOCAB, size=(32,)).astype(np.int32)
+    bad_slots = [0, 5, 17, 31]
+    ids[bad_slots[0]] = VOCAB * 10  # far out of range (also int32-overflow bait)
+    ids[bad_slots[1]] = -3
+    ids[bad_slots[2]] = VOCAB  # first row past the end
+    ids[bad_slots[3]] = 2**30  # would overflow id*dim in int32
+    ids = jnp.asarray(ids)
+
+    table_s = jax.device_put(table, NamedSharding(mesh, P(mesh.axis_names[0])))
+    ids_s = jax.device_put(ids, NamedSharding(mesh, P(mesh.axis_names[0])))
+    out = np.asarray(
+        jax.jit(_sharded_fn(mesh, embedding_lookup, impl))(table_s, ids_s)
+    )
+    for i in range(32):
+        if i in bad_slots:
+            assert np.isnan(out[i]).all(), f"row {i} (junk id) must be NaN"
+        else:
+            np.testing.assert_allclose(
+                out[i], np.asarray(table)[int(ids[i])], rtol=1e-6
+            )
+
+
+@pytest.mark.parametrize("impl", IMPLS)
+def test_sharded_lookup_oov_gradient_dropped(devices, impl):
+    """Junk-id cotangents are dropped, not scattered into a wrong row: the
+    grad with junk ids present equals the grad with them excluded."""
+    mesh = create_mesh(devices)
+    axis = mesh.axis_names[0]
+    table = _table(jax.random.key(0))
+    ids = jnp.array(
+        [3, -7, 3, VOCAB * 4, 9, 2**30, 1, 0] + list(range(8)), jnp.int32
+    )
+    cot = jax.random.normal(jax.random.key(2), (ids.shape[0], DIM))
+
+    good = np.asarray(ids) >= 0
+    good &= np.asarray(ids) < VOCAB
+    expected = jax.grad(
+        lambda t: jnp.sum(
+            jnp.take(t, jnp.asarray(np.asarray(ids)[good]), axis=0)
+            * jnp.asarray(np.asarray(cot)[good])
+        )
+    )(table)
+
+    ctx = ParallelContext(
+        axis_name=axis, sharded_embeddings=True, embedding_impl=impl
+    )
+
+    def local_loss(t, i, c):
+        vec = embedding_lookup(t, i, ctx)
+        return jnp.sum(jnp.where(jnp.isnan(vec), 0.0, vec * c))
+
+    mapped = shard_map(
+        jax.grad(local_loss),
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis)),
+        out_specs=P(axis),
+        check_vma=False,
+    )
+    sh = lambda a: jax.device_put(a, NamedSharding(mesh, P(axis)))
+    grad = jax.jit(mapped)(sh(table), sh(ids), sh(cot))
+    np.testing.assert_allclose(
+        np.asarray(grad), np.asarray(expected), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_lookup_impls_match_config():
+    """config.py inlines the impl tuple (to stay jax-free); keep in sync."""
+    from elasticdl_tpu.common.config import JobConfig
+    from elasticdl_tpu.ops.embedding import LOOKUP_IMPLS
+
+    for impl in LOOKUP_IMPLS:
+        JobConfig(embedding_lookup_impl=impl).validate()
+    with pytest.raises(ValueError, match="embedding_lookup_impl"):
+        JobConfig(embedding_lookup_impl="bogus").validate()

@@ -88,6 +88,7 @@ def test_ps_strategy_step_and_convergence(devices, spec_fn, batch_fn):
     assert float(metrics["loss"]) < first
 
 
+@pytest.mark.parametrize("impl", ["dense", "ragged_emulated"])
 @pytest.mark.parametrize(
     "spec_fn,batch_fn",
     [
@@ -96,10 +97,10 @@ def test_ps_strategy_step_and_convergence(devices, spec_fn, batch_fn):
     ],
     ids=["deepfm", "wide_deep"],
 )
-def test_ps_matches_allreduce(devices, spec_fn, batch_fn):
+def test_ps_matches_allreduce(devices, spec_fn, batch_fn, impl):
     """The hybrid's sharded-table path must produce the same update as plain
     replicated-table allreduce — the decisive numerics check for the
-    collective embedding transpose."""
+    collective embedding transpose (both lookup routes)."""
     batch = batch_fn(jax.random.key(2), BATCH)
     results = {}
     for strategy in (
@@ -108,7 +109,13 @@ def test_ps_matches_allreduce(devices, spec_fn, batch_fn):
     ):
         spec = spec_fn()
         mesh = create_mesh(devices)
-        trainer = Trainer(spec, JobConfig(distribution_strategy=strategy), mesh)
+        trainer = Trainer(
+            spec,
+            JobConfig(
+                distribution_strategy=strategy, embedding_lookup_impl=impl
+            ),
+            mesh,
+        )
         state = trainer.init_state(jax.random.key(0))
         state, metrics = trainer.train_step(state, trainer.shard_batch(batch))
         results[strategy] = (
