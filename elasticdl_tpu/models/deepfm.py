@@ -36,7 +36,7 @@ from elasticdl_tpu.models.tabular import (
 from elasticdl_tpu.ops.embedding import (
     ParallelContext,
     embedding_lookup,
-    init_flat_table,
+    pack_table,
 )
 
 NUM_DENSE = 13
@@ -52,11 +52,24 @@ def _init_params(
     vocab = NUM_CAT * buckets_per_feature
     ks = jax.random.split(rng, 4 + len(hidden))
     glorot = jax.nn.initializers.glorot_normal()
+    # One sharded table (the "parameter server" part) holds BOTH the FM
+    # embedding (dims 0..embedding_dim-1, normal init) and the first-order
+    # linear weight (last dim, zero init) per id: the per-id scatter/gather
+    # cost is per PHYSICAL ROW (128 lanes) regardless of dim, so a separate
+    # dim-1 linear table would double the dominant scatter-add for 1/128th
+    # of a row's payload (profiled: tools/profile_step.py).  Stored
+    # lane-packed — see ops/embedding.py: whole-physical-row gathers/
+    # scatters are the TPU fast path (flat-slice layout hit a serial
+    # per-row loop).
+    fm_logical = jnp.concatenate(
+        [
+            jax.random.normal(ks[0], (vocab, embedding_dim)) * 0.01,
+            jnp.zeros((vocab, 1), jnp.float32),
+        ],
+        axis=-1,
+    )
     params: Dict[str, Any] = {
-        # Sharded tables (the "parameter server" part), stored FLAT — see
-        # ops/embedding.py: contiguous-slice gathers are the TPU fast path.
-        "fm_embedding": init_flat_table(ks[0], vocab, embedding_dim),
-        "fm_linear": init_flat_table(ks[1], vocab, 1),
+        "fm_table": pack_table(fm_logical, embedding_dim + 1),
         # Replicated dense params (the "allreduce" part).
         "dense_linear": {
             "w": jnp.zeros((NUM_DENSE, 1), jnp.float32),
@@ -91,14 +104,14 @@ def _apply(
     ids = fuse_feature_ids(batch["cat"], buckets_per_feature)  # [b, 26]
     dense = log_normalize(batch["dense"])  # [b, 13] f32
 
-    emb = embedding_lookup(params["fm_embedding"], ids, ctx, dim=embedding_dim)
-    lin = embedding_lookup(params["fm_linear"], ids, ctx, dim=1)  # [b, 26, 1]
+    vecs = embedding_lookup(params["fm_table"], ids, ctx, dim=embedding_dim + 1)
+    emb, lin = vecs[..., :embedding_dim], vecs[..., embedding_dim]  # [b,26,d],[b,26]
 
     emb = emb.astype(compute_dtype)
     dense_c = dense.astype(compute_dtype)
 
     # First-order: sparse linear + dense linear.
-    first = jnp.sum(lin[..., 0], axis=-1, dtype=jnp.float32)
+    first = jnp.sum(lin, axis=-1, dtype=jnp.float32)
     dl = params["dense_linear"]
     first = first + (dense @ dl["w"])[:, 0] + dl["b"][0]
 
@@ -168,8 +181,7 @@ def model_spec(
         metrics=_metrics,
         optimizer=optax.adam(learning_rate),
         embedding_tables=[
-            EmbeddingTableSpec(path=("fm_embedding",), vocab_size=vocab, dim=dim),
-            EmbeddingTableSpec(path=("fm_linear",), vocab_size=vocab, dim=1),
+            EmbeddingTableSpec(path=("fm_table",), vocab_size=vocab, dim=dim + 1),
         ],
         feed=criteo_feed,
         example_batch=_example_batch,

@@ -36,8 +36,8 @@ from elasticdl_tpu.models.tabular import (
 from elasticdl_tpu.ops.embedding import (
     ParallelContext,
     embedding_lookup,
-    flat_table_size,
-    init_flat_table,
+    init_table,
+    table_shape,
 )
 
 NUM_DENSE = 5
@@ -62,9 +62,9 @@ def _init_params(rng, buckets: int, embedding_dim: int, hidden: tuple):
     ks = jax.random.split(rng, 3 + len(hidden))
     glorot = jax.nn.initializers.glorot_normal()
     params: Dict[str, Any] = {
-        # Flat tables — see ops/embedding.py for why (TPU gather layout).
-        "wide": jnp.zeros((flat_table_size(wide_vocab, 1),), jnp.float32),
-        "deep_embedding": init_flat_table(
+        # Lane-packed tables — see ops/embedding.py for why (TPU gather layout).
+        "wide": jnp.zeros(table_shape(wide_vocab, 1), jnp.float32),
+        "deep_embedding": init_table(
             ks[0], deep_vocab, embedding_dim, scale=0.05
         ),
         "mlp": {},

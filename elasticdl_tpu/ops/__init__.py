@@ -1,5 +1,8 @@
 from elasticdl_tpu.ops.embedding import (  # noqa: F401
     ParallelContext,
     embedding_lookup,
+    init_table,
+    pack_table,
     pad_vocab,
+    table_shape,
 )

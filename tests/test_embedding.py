@@ -1,19 +1,27 @@
 """Sharded embedding lookup: parity with a plain gather, and gradient
 correctness (incl. duplicate-id accumulation) — the TPU-native analogue of the
-reference's embedding-layer-vs-fake-PS unit tests (SURVEY.md §4)."""
+reference's embedding-layer-vs-fake-PS unit tests (SURVEY.md §4).
+
+Tables are lane-packed [P, pack*dim] (pack = 128//dim logical rows per
+physical row — ops/embedding.py module docstring); a plain [V, dim] table is
+the pack == 1 case.  Tests cover both, since models use pack > 1 layouts."""
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import lax
 from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from elasticdl_tpu.ops.embedding import (
     ParallelContext,
     embedding_lookup,
+    gather_rows,
+    pack_table,
     pad_vocab,
+    row_pack,
+    table_shape,
+    unpack_table,
 )
 from elasticdl_tpu.parallel.mesh import create_mesh
 
@@ -31,160 +39,215 @@ DIM = 16
 # the real op through the identical code path).
 IMPLS = ("dense", "ragged_emulated")
 
+# Table layouts: plain [V, D] (pack=1: dim passed = width) and lane-packed
+# [V/pack, pack*D] (pack=8 for DIM=16).  Both must behave identically.
+LAYOUTS = ("plain", "packed")
+
 
 def _table(rng):
     return jax.random.normal(rng, (VOCAB, DIM), jnp.float32)
 
 
-def _sharded_fn(mesh, fn, impl="dense"):
+def _layout(table2d, layout):
+    """(table_array, lookup_dim) for a layout.  'packed' packs WITHOUT vocab
+    padding (VOCAB already divides the mesh) so shard math stays exact."""
+    if layout == "plain":
+        return table2d, DIM
+    pack = row_pack(DIM)
+    return table2d.reshape(table2d.shape[0] // pack, pack * DIM), DIM
+
+
+def _sharded_fn(mesh, impl="dense"):
+    # Layout needs no parameter: embedding_lookup derives pack/stride from
+    # the table array's width and dim=DIM, for plain and packed alike.
     axis = mesh.axis_names[0]
     ctx = ParallelContext(
         axis_name=axis, sharded_embeddings=True, embedding_impl=impl
     )
     return shard_map(
-        lambda t, i: fn(t, i, ctx),
-        mesh=mesh,
-        in_specs=(P(axis), P(axis)),
-        out_specs=P(axis),
-        check_vma=False,
-    )
-
-
-def test_pad_vocab():
-    assert pad_vocab(1) == 256
-    assert pad_vocab(256) == 256
-    assert pad_vocab(257) == 512
-
-
-def test_flat_lookup_matches_2d(devices):
-    """Flat [V*D] storage must agree with the 2-D [V, D] path, fwd and grad
-    (including duplicate-id accumulation)."""
-    from elasticdl_tpu.ops.embedding import gather_rows
-
-    table = _table(jax.random.key(0))
-    flat = table.reshape(-1)
-    ids = jnp.array([[3, 3], [0, 63], [17, 3]], jnp.int32)
-    ctx = ParallelContext()
-    out2 = embedding_lookup(table, ids, ctx)
-    out1 = embedding_lookup(flat, ids, ctx, dim=DIM)
-    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2), rtol=1e-6)
-    np.testing.assert_allclose(
-        np.asarray(gather_rows(flat, ids, DIM)), np.asarray(out2), rtol=1e-6
-    )
-
-    cot = jax.random.normal(jax.random.key(2), out2.shape)
-    g2 = jax.grad(lambda t: jnp.sum(embedding_lookup(t, ids, ctx) * cot))(table)
-    g1 = jax.grad(
-        lambda t: jnp.sum(embedding_lookup(t, ids, ctx, dim=DIM) * cot)
-    )(flat)
-    np.testing.assert_allclose(
-        np.asarray(g1), np.asarray(g2).reshape(-1), rtol=1e-5
-    )
-
-
-def test_flat_table_int32_guard():
-    from elasticdl_tpu.ops.embedding import flat_table_size
-
-    assert flat_table_size(1000, 8) == 1024 * 8
-    with pytest.raises(ValueError, match="int32"):
-        flat_table_size(300_000_000, 8)
-
-
-def test_flat_lookup_dim_validation():
-    ctx = ParallelContext()
-    with pytest.raises(ValueError, match="explicit dim"):
-        embedding_lookup(jnp.zeros((64,)), jnp.zeros((2,), jnp.int32), ctx)
-    with pytest.raises(ValueError, match="dim"):
-        embedding_lookup(
-            jnp.zeros((64, 4)), jnp.zeros((2,), jnp.int32), ctx, dim=8
-        )
-
-
-@pytest.mark.parametrize("impl", IMPLS)
-@pytest.mark.parametrize("n_dev", [1, 4, 8])
-def test_sharded_flat_lookup_matches_gather(devices, n_dev, impl):
-    mesh = create_mesh(devices, num_devices=n_dev)
-    axis = mesh.axis_names[0]
-    table = _table(jax.random.key(0))
-    flat = table.reshape(-1)
-    ids = jax.random.randint(jax.random.key(1), (32,), 0, VOCAB)
-    expected = jnp.take(table, ids, axis=0)
-
-    ctx = ParallelContext(
-        axis_name=axis, sharded_embeddings=True, embedding_impl=impl
-    )
-    mapped = shard_map(
         lambda t, i: embedding_lookup(t, i, ctx, dim=DIM),
         mesh=mesh,
         in_specs=(P(axis), P(axis)),
         out_specs=P(axis),
         check_vma=False,
     )
-    sh = lambda a: jax.device_put(a, NamedSharding(mesh, P(axis)))
-    out = jax.jit(mapped)(sh(flat), sh(ids))
-    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), rtol=1e-6)
 
 
-@pytest.mark.parametrize("impl", IMPLS)
-def test_sharded_flat_gradient_duplicates(devices, impl):
-    mesh = create_mesh(devices)
-    axis = mesh.axis_names[0]
+def test_pad_vocab_and_shapes():
+    # dim 128+ -> pack 1, physical rows = padded vocab, multiple of 256.
+    assert pad_vocab(1, 128) == 256
+    assert pad_vocab(256, 128) == 256
+    assert pad_vocab(257, 128) == 512
+    # dim 8 -> pack 16 -> vocab pads to 16*256=4096 logical rows.
+    assert row_pack(8) == 16
+    assert pad_vocab(1, 8) == 4096
+    assert table_shape(1, 8) == (256, 128)
+    # Criteo fused table: 26*65536 logical rows, dim 8.
+    assert table_shape(26 * 65536, 8) == (26 * 65536 // 16, 128)
+    # dim 1 -> pack 128.
+    assert table_shape(1000, 1) == (256, 128)
+    # dim that isn't a power of two: rows pad to the next-pow2 stride so the
+    # physical width stays exactly 128 (misaligned widths gather ~3x slower).
+    assert row_pack(48) == 2  # stride 64
+    assert table_shape(513, 48) == (512, 128)  # 513 logical -> 1024 padded
+    assert row_pack(9) == 8  # stride 16 (the DeepFM folded emb+linear table)
+    assert table_shape(26 * 65536, 9) == (26 * 65536 // 8, 128)
+    # dim > 128 pads to the next multiple of 128, pack 1.
+    assert table_shape(300, 200) == (512, 256)
+
+
+def test_pack_unpack_roundtrip():
     table = _table(jax.random.key(0))
-    flat = table.reshape(-1)
-    ids = jnp.array([3, 3, 3, 3, 3, 3, 3, 3, 0, 1, 2, 4, 5, 6, 7, 8], jnp.int32)
-    cot = jax.random.normal(jax.random.key(2), (ids.shape[0], DIM))
+    packed = pack_table(table, DIM)
+    assert packed.shape == table_shape(VOCAB, DIM)
+    # Rows survive, padding rows are zero.
+    logical = unpack_table(packed, DIM)
+    np.testing.assert_array_equal(np.asarray(logical[:VOCAB]), np.asarray(table))
+    assert not np.asarray(logical[VOCAB:]).any()
+    # Flat input packs identically.
+    packed_flat = pack_table(table.reshape(-1), DIM)
+    np.testing.assert_array_equal(np.asarray(packed_flat), np.asarray(packed))
+    with pytest.raises(ValueError, match="multiple"):
+        pack_table(jnp.zeros((65,)), DIM)
 
-    expected = jax.grad(
-        lambda t: jnp.sum(jnp.take(t, ids, axis=0) * cot)
-    )(table).reshape(-1)
 
-    ctx = ParallelContext(
-        axis_name=axis, sharded_embeddings=True, embedding_impl=impl
+def test_packed_lookup_matches_plain(devices):
+    """Lane-packed storage must agree with the plain [V, D] path, fwd and grad
+    (including duplicate-id accumulation)."""
+    table = _table(jax.random.key(0))
+    packed, _ = _layout(table, "packed")
+    ids = jnp.array([[3, 3], [0, 63], [17, 3]], jnp.int32)
+    ctx = ParallelContext()
+    out_plain = embedding_lookup(table, ids, ctx)
+    out_packed = embedding_lookup(packed, ids, ctx, dim=DIM)
+    np.testing.assert_allclose(
+        np.asarray(out_packed), np.asarray(out_plain), rtol=1e-6
     )
-    mapped = shard_map(
-        jax.grad(
-            lambda t, i, c: jnp.sum(embedding_lookup(t, i, ctx, dim=DIM) * c)
-        ),
-        mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis)),
-        out_specs=P(axis),
-        check_vma=False,
+    np.testing.assert_allclose(
+        np.asarray(gather_rows(packed, ids, DIM)), np.asarray(out_plain),
+        rtol=1e-6,
     )
-    sh = lambda a: jax.device_put(a, NamedSharding(mesh, P(axis)))
-    grad = jax.jit(mapped)(sh(flat), sh(ids), sh(cot))
-    np.testing.assert_allclose(np.asarray(grad), np.asarray(expected), rtol=1e-5)
+
+    cot = jax.random.normal(jax.random.key(2), out_plain.shape)
+    g_plain = jax.grad(
+        lambda t: jnp.sum(embedding_lookup(t, ids, ctx) * cot)
+    )(table)
+    g_packed = jax.grad(
+        lambda t: jnp.sum(embedding_lookup(t, ids, ctx, dim=DIM) * cot)
+    )(packed)
+    np.testing.assert_allclose(
+        np.asarray(g_packed).reshape(-1, DIM),
+        np.asarray(g_plain),
+        rtol=1e-5,
+    )
 
 
+def test_stride_padded_lookup_matches_plain():
+    """Non-power-of-two dim (9, the DeepFM folded table): rows live at
+    stride 16 with dead lanes; lookup and grad must match the plain table."""
+    dim = 9
+    table = jax.random.normal(jax.random.key(0), (40, dim), jnp.float32)
+    packed = pack_table(table, dim)
+    assert packed.shape == table_shape(40, dim)
+    # dup + 2 OOV (3000 is past the PADDED vocab of 2048; 40..2047 are valid
+    # zero padding rows by the module contract, not OOV)
+    ids = jnp.array([0, 7, 39, 7, 3000, -1], jnp.int32)
+    out = np.asarray(gather_rows(packed, ids, dim))
+    exp = np.asarray(table)
+    for i, idx in enumerate([0, 7, 39, 7]):
+        np.testing.assert_allclose(out[i], exp[idx], rtol=1e-6)
+    assert np.isnan(out[4]).all() and np.isnan(out[5]).all()
+
+    cot = jax.random.normal(jax.random.key(1), (6, dim))
+    g_packed = jax.grad(
+        lambda t: jnp.sum(jnp.where(jnp.isnan(gather_rows(t, ids, dim)), 0.0,
+                                    gather_rows(t, ids, dim) * cot))
+    )(packed)
+    good = [0, 7, 39, 7]
+    g_exp = jax.grad(
+        lambda t: jnp.sum(jnp.take(t, jnp.array(good), axis=0) * cot[:4])
+    )(table)
+    np.testing.assert_allclose(
+        np.asarray(unpack_table(g_packed, dim))[:40], np.asarray(g_exp),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_pad_embedding_tables_undersized_leaf():
+    """A user table built for the RAW vocab (fewer rows than the declared
+    padded vocab) zero-pads up to the declared shape; an oversized or
+    wrong-width leaf raises."""
+    from elasticdl_tpu.models.spec import EmbeddingTableSpec
+    from elasticdl_tpu.parallel.trainer import pad_embedding_tables
+
+    spec = [EmbeddingTableSpec(path=("t",), vocab_size=5000, dim=16)]
+    leaf = jnp.ones((1000, 16), jnp.float32)
+    out = pad_embedding_tables({"t": leaf}, spec)["t"]
+    assert out.shape == table_shape(5000, 16)
+    logical = unpack_table(out, 16)
+    np.testing.assert_array_equal(np.asarray(logical[:1000]), np.asarray(leaf))
+    assert not np.asarray(logical[1000:]).any()
+
+    with pytest.raises(ValueError, match="incompatible"):
+        pad_embedding_tables({"t": jnp.ones((9000, 16))}, spec)
+
+
+def test_lookup_validation():
+    ctx = ParallelContext()
+    with pytest.raises(ValueError, match="pack_table"):
+        embedding_lookup(jnp.zeros((64,)), jnp.zeros((2,), jnp.int32), ctx)
+    with pytest.raises(ValueError, match="stride"):
+        embedding_lookup(
+            jnp.zeros((64, 6)), jnp.zeros((2,), jnp.int32), ctx, dim=3
+        )
+
+
+def test_oov_is_nan_local():
+    """Single-device fail-loud OOV for both layouts, both id signs."""
+    table = _table(jax.random.key(0))
+    for layout in LAYOUTS:
+        arr, dim = _layout(table, layout)
+        ids = jnp.array([0, -1, VOCAB - 1, VOCAB, 2**30, -(2**30)], jnp.int32)
+        out = np.asarray(gather_rows(arr, ids, dim))
+        np.testing.assert_allclose(out[0], np.asarray(table)[0], rtol=1e-6)
+        np.testing.assert_allclose(
+            out[2], np.asarray(table)[VOCAB - 1], rtol=1e-6
+        )
+        for bad in (1, 3, 4, 5):
+            assert np.isnan(out[bad]).all(), (layout, bad)
+
+
+@pytest.mark.parametrize("layout", LAYOUTS)
 @pytest.mark.parametrize("impl", IMPLS)
 @pytest.mark.parametrize("n_dev", [1, 4, 8])
-def test_sharded_lookup_matches_gather(devices, n_dev, impl):
+def test_sharded_lookup_matches_gather(devices, n_dev, impl, layout):
     mesh = create_mesh(devices, num_devices=n_dev)
     table = _table(jax.random.key(0))
+    arr, dim = _layout(table, layout)
     ids = jax.random.randint(jax.random.key(1), (32,), 0, VOCAB)
 
     expected = jnp.take(table, ids, axis=0)
-
-    table_s = jax.device_put(table, NamedSharding(mesh, P(mesh.axis_names[0])))
-    ids_s = jax.device_put(ids, NamedSharding(mesh, P(mesh.axis_names[0])))
-    out = jax.jit(_sharded_fn(mesh, embedding_lookup, impl))(table_s, ids_s)
+    sh = lambda a: jax.device_put(a, NamedSharding(mesh, P(mesh.axis_names[0])))
+    out = jax.jit(_sharded_fn(mesh, impl))(sh(arr), sh(ids))
     np.testing.assert_allclose(np.asarray(out), np.asarray(expected), rtol=1e-6)
 
 
+@pytest.mark.parametrize("layout", LAYOUTS)
 @pytest.mark.parametrize("impl", IMPLS)
-def test_sharded_lookup_skewed_ids(devices, impl):
+def test_sharded_lookup_skewed_ids(devices, impl, layout):
     """Worst-case routing skew: every device's ids all live on ONE shard (the
     ragged route's send sizes are maximally unbalanced)."""
     mesh = create_mesh(devices)
     table = _table(jax.random.key(0))
+    arr, dim = _layout(table, layout)
     rows_per_shard = VOCAB // 8
     # All 32 ids hit shard 5's row range.
     ids = jax.random.randint(
         jax.random.key(3), (32,), 5 * rows_per_shard, 6 * rows_per_shard
     )
     expected = jnp.take(table, ids, axis=0)
-    table_s = jax.device_put(table, NamedSharding(mesh, P(mesh.axis_names[0])))
-    ids_s = jax.device_put(ids, NamedSharding(mesh, P(mesh.axis_names[0])))
-    out = jax.jit(_sharded_fn(mesh, embedding_lookup, impl))(table_s, ids_s)
+    sh = lambda a: jax.device_put(a, NamedSharding(mesh, P(mesh.axis_names[0])))
+    out = jax.jit(_sharded_fn(mesh, impl))(sh(arr), sh(ids))
     np.testing.assert_allclose(np.asarray(out), np.asarray(expected), rtol=1e-6)
 
 
@@ -196,19 +259,20 @@ def test_sharded_lookup_2d_ids(devices, impl):
     ids = jax.random.randint(jax.random.key(1), (16, 5), 0, VOCAB)
 
     expected = jnp.take(table, ids, axis=0)
-    table_s = jax.device_put(table, NamedSharding(mesh, P(mesh.axis_names[0])))
-    ids_s = jax.device_put(ids, NamedSharding(mesh, P(mesh.axis_names[0])))
-    out = jax.jit(_sharded_fn(mesh, embedding_lookup, impl))(table_s, ids_s)
+    sh = lambda a: jax.device_put(a, NamedSharding(mesh, P(mesh.axis_names[0])))
+    out = jax.jit(_sharded_fn(mesh, impl))(sh(table), sh(ids))
     np.testing.assert_allclose(np.asarray(out), np.asarray(expected), rtol=1e-6)
 
 
+@pytest.mark.parametrize("layout", LAYOUTS)
 @pytest.mark.parametrize("impl", IMPLS)
-def test_sharded_lookup_gradient_accumulates_duplicates(devices, impl):
+def test_sharded_lookup_gradient_accumulates_duplicates(devices, impl, layout):
     """d(loss)/d(table) must scatter-ADD cotangents for duplicate ids — the
     reference's IndexedSlices semantics on the PS side."""
     mesh = create_mesh(devices)
     axis = mesh.axis_names[0]
     table = _table(jax.random.key(0))
+    arr, dim = _layout(table, layout)
     # Every device looks up id 3 (heavy duplication across the mesh) plus a
     # unique id, so the grad row for 3 accumulates 8 contributions.
     ids = jnp.array([3, 3, 3, 3, 3, 3, 3, 3, 0, 1, 2, 4, 5, 6, 7, 8], jnp.int32)
@@ -217,7 +281,7 @@ def test_sharded_lookup_gradient_accumulates_duplicates(devices, impl):
     def ref_loss(t):
         return jnp.sum(jnp.take(t, ids, axis=0) * cot)
 
-    expected_grad = jax.grad(ref_loss)(table)
+    expected_grad = np.asarray(jax.grad(ref_loss)(table))
 
     ctx = ParallelContext(
         axis_name=axis, sharded_embeddings=True, embedding_impl=impl
@@ -228,7 +292,7 @@ def test_sharded_lookup_gradient_accumulates_duplicates(devices, impl):
         # so the collective transposes deliver d(sum_i loss_i)/d(table) into the
         # row shards.  (psum inside the grad would double-count under
         # check_vma=False, whose conservative psum transpose is psum.)
-        vec = embedding_lookup(t, i, ctx)
+        vec = embedding_lookup(t, i, ctx, dim=DIM)
         return jnp.sum(vec * c)
 
     mapped = shard_map(
@@ -239,30 +303,31 @@ def test_sharded_lookup_gradient_accumulates_duplicates(devices, impl):
         check_vma=False,
     )
     sh = lambda a: jax.device_put(a, NamedSharding(mesh, P(axis)))
-    grad = jax.jit(mapped)(sh(table), sh(ids), sh(cot))
-    np.testing.assert_allclose(np.asarray(grad), np.asarray(expected_grad), rtol=1e-5)
+    grad = np.asarray(jax.jit(mapped)(sh(arr), sh(ids), sh(cot)))
+    np.testing.assert_allclose(
+        grad.reshape(-1, DIM), expected_grad, rtol=1e-5
+    )
 
 
+@pytest.mark.parametrize("layout", LAYOUTS)
 @pytest.mark.parametrize("impl", IMPLS)
-def test_sharded_lookup_oov_is_nan(devices, impl):
+def test_sharded_lookup_oov_is_nan(devices, impl, layout):
     """Fail-loud OOV: ids outside the padded global vocab come back as NaN
     rows in SHARDED mode too (VERDICT r1 'loud OOV'), never zeros or a
     silently wrong row; in-range rows are unaffected."""
     mesh = create_mesh(devices)
     table = _table(jax.random.key(0))
+    arr, dim = _layout(table, layout)
     ids = np.random.default_rng(0).integers(0, VOCAB, size=(32,)).astype(np.int32)
     bad_slots = [0, 5, 17, 31]
-    ids[bad_slots[0]] = VOCAB * 10  # far out of range (also int32-overflow bait)
+    ids[bad_slots[0]] = VOCAB * 10  # far out of range
     ids[bad_slots[1]] = -3
     ids[bad_slots[2]] = VOCAB  # first row past the end
-    ids[bad_slots[3]] = 2**30  # would overflow id*dim in int32
+    ids[bad_slots[3]] = 2**30  # huge junk id
     ids = jnp.asarray(ids)
 
-    table_s = jax.device_put(table, NamedSharding(mesh, P(mesh.axis_names[0])))
-    ids_s = jax.device_put(ids, NamedSharding(mesh, P(mesh.axis_names[0])))
-    out = np.asarray(
-        jax.jit(_sharded_fn(mesh, embedding_lookup, impl))(table_s, ids_s)
-    )
+    sh = lambda a: jax.device_put(a, NamedSharding(mesh, P(mesh.axis_names[0])))
+    out = np.asarray(jax.jit(_sharded_fn(mesh, impl))(sh(arr), sh(ids)))
     for i in range(32):
         if i in bad_slots:
             assert np.isnan(out[i]).all(), f"row {i} (junk id) must be NaN"
@@ -272,13 +337,15 @@ def test_sharded_lookup_oov_is_nan(devices, impl):
             )
 
 
+@pytest.mark.parametrize("layout", LAYOUTS)
 @pytest.mark.parametrize("impl", IMPLS)
-def test_sharded_lookup_oov_gradient_dropped(devices, impl):
+def test_sharded_lookup_oov_gradient_dropped(devices, impl, layout):
     """Junk-id cotangents are dropped, not scattered into a wrong row: the
     grad with junk ids present equals the grad with them excluded."""
     mesh = create_mesh(devices)
     axis = mesh.axis_names[0]
     table = _table(jax.random.key(0))
+    arr, dim = _layout(table, layout)
     ids = jnp.array(
         [3, -7, 3, VOCAB * 4, 9, 2**30, 1, 0] + list(range(8)), jnp.int32
     )
@@ -286,19 +353,21 @@ def test_sharded_lookup_oov_gradient_dropped(devices, impl):
 
     good = np.asarray(ids) >= 0
     good &= np.asarray(ids) < VOCAB
-    expected = jax.grad(
-        lambda t: jnp.sum(
-            jnp.take(t, jnp.asarray(np.asarray(ids)[good]), axis=0)
-            * jnp.asarray(np.asarray(cot)[good])
-        )
-    )(table)
+    expected = np.asarray(
+        jax.grad(
+            lambda t: jnp.sum(
+                jnp.take(t, jnp.asarray(np.asarray(ids)[good]), axis=0)
+                * jnp.asarray(np.asarray(cot)[good])
+            )
+        )(table)
+    )
 
     ctx = ParallelContext(
         axis_name=axis, sharded_embeddings=True, embedding_impl=impl
     )
 
     def local_loss(t, i, c):
-        vec = embedding_lookup(t, i, ctx)
+        vec = embedding_lookup(t, i, ctx, dim=DIM)
         return jnp.sum(jnp.where(jnp.isnan(vec), 0.0, vec * c))
 
     mapped = shard_map(
@@ -309,10 +378,24 @@ def test_sharded_lookup_oov_gradient_dropped(devices, impl):
         check_vma=False,
     )
     sh = lambda a: jax.device_put(a, NamedSharding(mesh, P(axis)))
-    grad = jax.jit(mapped)(sh(table), sh(ids), sh(cot))
+    grad = np.asarray(jax.jit(mapped)(sh(arr), sh(ids), sh(cot)))
     np.testing.assert_allclose(
-        np.asarray(grad), np.asarray(expected), rtol=1e-5, atol=1e-6
+        grad.reshape(-1, DIM), expected, rtol=1e-5, atol=1e-6
     )
+
+
+def test_resolve_impl_mesh_size_aware():
+    """auto at axis_size 1 is a local gather (dense n=1 short-circuit), never
+    the ragged machinery — VERDICT r2 Weak #1.  Explicit impls pass through."""
+    from elasticdl_tpu.ops.embedding import resolve_impl
+
+    assert resolve_impl("auto", "tpu", axis_size=1) == "dense"
+    assert resolve_impl("auto", "tpu", axis_size=8) == "ragged"
+    assert resolve_impl("auto", "cpu", axis_size=8) == "dense"
+    assert resolve_impl("ragged", "tpu", axis_size=1) == "ragged"
+    assert resolve_impl("ragged_emulated", "cpu", axis_size=1) == "ragged_emulated"
+    with pytest.raises(ValueError, match="unknown"):
+        resolve_impl("bogus")
 
 
 def test_lookup_impls_match_config():
