@@ -30,6 +30,12 @@ class RendezvousServer:
         # worker_id -> advertised host (multi-host: seeds the rank-0
         # jax.distributed coordinator; empty for single-host workers)
         self._addresses: Dict[str, str] = {}
+        # worker_id -> latest membership version the worker has CONFIRMED
+        # applying (via registration or a version-carrying heartbeat).  The
+        # lockstep task log uses this to withhold collective tasks until the
+        # whole world agrees on the topology — a member acting on a stale
+        # view would leave its peers wedged inside a collective.
+        self._confirmed: Dict[str, int] = {}
         self._version = 0
         self._timeout = heartbeat_timeout_s
         self._clock = clock
@@ -43,13 +49,18 @@ class RendezvousServer:
         for fn in self._listeners:
             fn(version, members)
 
-    def register(self, worker_id: str, address: str = "") -> int:
+    def register(self, worker_id: str, address: str = "", confirmed: bool = True) -> int:
         """Worker joins (or re-joins). Returns the new membership version.
 
         A re-registration with a CHANGED address also bumps the version:
         peers cache the coordinator address from the membership view, and a
         worker restarted on a new host within the heartbeat window would
         otherwise never be re-discovered.
+
+        ``confirmed=False`` is the heartbeat-revival path: the worker is
+        alive but has NOT (re)applied the current membership — stamping it
+        confirmed would let the lockstep task log issue collective work to a
+        world one member hasn't actually joined (split-brain).
         """
         with self._lock:
             changed = worker_id not in self._workers or (
@@ -59,8 +70,16 @@ class RendezvousServer:
             if address:
                 self._addresses[worker_id] = address
             if not changed:
+                if confirmed:
+                    self._confirmed[worker_id] = self._version
                 return self._version
             self._version += 1
+            if confirmed:
+                # Registration hands the worker this very version, so it
+                # counts as confirmed; everyone else re-confirms by heartbeat.
+                self._confirmed[worker_id] = self._version
+            else:
+                self._confirmed.pop(worker_id, None)
             members = sorted(self._workers)
             version = self._version
         self._notify(version, members)
@@ -72,18 +91,37 @@ class RendezvousServer:
                 return self._version
             del self._workers[worker_id]
             self._addresses.pop(worker_id, None)
+            self._confirmed.pop(worker_id, None)
             self._version += 1
             version, members = self._version, sorted(self._workers)
         self._notify(version, members)
         return version
 
-    def heartbeat(self, worker_id: str) -> int:
-        """Refresh liveness; re-registers a worker the reaper evicted."""
+    def heartbeat(self, worker_id: str, version: Optional[int] = None) -> int:
+        """Refresh liveness; re-registers a worker the reaper evicted.
+
+        ``version`` (when the caller sends one) records the membership
+        version this worker has confirmed applying — see ``all_confirmed``.
+        """
         with self._lock:
             if worker_id in self._workers:
                 self._workers[worker_id] = self._clock()
+                if version is not None:
+                    self._confirmed[worker_id] = int(version)
                 return self._version
-        return self.register(worker_id)
+        # Revival of an evicted worker: alive, but its address was dropped at
+        # eviction and it has not applied the post-revival membership — so it
+        # must NOT count as confirmed (the returned version differs from the
+        # worker's own, which makes it re-read membership / restart).
+        return self.register(worker_id, confirmed=False)
+
+    def all_confirmed(self, version: int) -> bool:
+        """True iff ``version`` is current and every live member has
+        confirmed it (registration or heartbeat)."""
+        with self._lock:
+            return version == self._version and all(
+                self._confirmed.get(w) == self._version for w in self._workers
+            )
 
     def reap_dead(self) -> List[str]:
         """Evict workers whose heartbeat is stale. Returns the evicted ids."""
@@ -97,6 +135,7 @@ class RendezvousServer:
             for w in dead:
                 del self._workers[w]
                 self._addresses.pop(w, None)
+                self._confirmed.pop(w, None)
             self._version += 1
             version, members = self._version, sorted(self._workers)
         self._notify(version, members)

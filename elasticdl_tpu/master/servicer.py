@@ -10,10 +10,12 @@ exposes the same handlers over gRPC for real deployments.
 Method table (the wire contract):
 
   GetTask            {worker_id}                       -> {task?, finished}
+  GetGroupTask       {worker_id, seq, version}         -> {task?, finished, stale}
   ReportTaskResult   {worker_id, task_id, success,
                       metrics?, weight?, model_version?} -> {accepted}
   ReportVersion      {worker_id, model_version}        -> {}
   RegisterWorker     {worker_id}                       -> membership
+  DeregisterWorker   {worker_id}                       -> {version}
   Heartbeat          {worker_id}                       -> {version}
   GetMembership      {}                                -> membership
   GetCheckpoint      {}                                -> {path?, step}
@@ -70,6 +72,17 @@ class MasterServicer:
         # A dead worker's tasks must be requeued in BOTH dispatchers.
         self.rendezvous.add_listener(self._on_membership_change)
         self._known_workers: set = set()
+        # Multi-host lockstep task log (GetGroupTask): every process of a
+        # jax.distributed world must execute the SAME task sequence, because
+        # the jitted step is a collective across all their devices —
+        # independent GetTask polls would deadlock the mesh (SURVEY.md §3.5;
+        # VERDICT r2 Missing #2).  Entry ``seq`` is materialized through the
+        # ordinary GetTask logic by whichever process asks first, attributed
+        # to a per-membership-version pseudo worker so a world change
+        # requeues the group's in-flight tasks.
+        self._group_lock = threading.Lock()
+        self._group_version: Optional[int] = None
+        self._group_log: list = []
 
     # -- rendezvous listener: requeue tasks of evicted workers --
 
@@ -86,6 +99,23 @@ class MasterServicer:
                     len(lost), len(lost_eval), worker_id,
                 )
         self._known_workers = set(members)
+        # The lockstep group's in-flight tasks are attributed to a
+        # per-version pseudo worker, invisible to the per-worker requeue
+        # above.  Any version change orphans them (every member restarts),
+        # and waiting for a NEW group to pull is not enough — after a
+        # scale-to-one the successor runs single-host and never calls
+        # GetGroupTask.  Requeue now.
+        with self._group_lock:
+            gv, self._group_version = self._group_version, None
+            self._group_log = []
+        if gv is not None and gv != version:
+            lost = self.dispatcher.recover_tasks(self.group_worker_id(gv))
+            if self.evaluation is not None:
+                lost += self.evaluation.recover_tasks(self.group_worker_id(gv))
+            if lost:
+                logger.info(
+                    "requeued %d lockstep tasks of group v%d", len(lost), gv
+                )
 
     # -- handlers (dict in, dict out) --
 
@@ -118,6 +148,59 @@ class MasterServicer:
         if task is None:
             return {"task": None, "finished": self.job_finished()}
         return {"task": task.to_dict(), "finished": False}
+
+    @staticmethod
+    def group_worker_id(version: int) -> str:
+        return f"__group_v{version}__"
+
+    def GetGroupTask(self, req: dict) -> dict:
+        """Lockstep task hand-out for a multi-host worker group.
+
+        All processes of membership ``version`` walk the same ``seq``-indexed
+        log; a response with ``stale`` means the caller's world is gone and it
+        must re-check membership (which restarts it in multihost mode).  A
+        transient ``{task: None, finished: False}`` is NOT logged — callers
+        retry the same seq.
+        """
+        seq = int(req["seq"])
+        version = int(req["version"])
+        stale = {"task": None, "finished": False, "stale": True}
+        if version != self.rendezvous.version():
+            return stale
+        with self._group_lock:
+            if self._group_version != version:
+                if self._group_version is not None:
+                    # New world: the old group's in-flight tasks can never be
+                    # reported (every member restarts) — requeue them now
+                    # rather than waiting out the task timeout.
+                    old = self.group_worker_id(self._group_version)
+                    self.dispatcher.recover_tasks(old)
+                    if self.evaluation is not None:
+                        self.evaluation.recover_tasks(old)
+                self._group_version = version
+                self._group_log = []
+            if seq < len(self._group_log):
+                return dict(self._group_log[seq], stale=False)
+            if not self.rendezvous.all_confirmed(version):
+                # A member still holds (or may hold) an older topology view;
+                # issuing a collective task now would wedge the others inside
+                # the collective waiting for it.  Withhold until every member
+                # has confirmed this version (heartbeat/registration).
+                return {"task": None, "finished": False, "stale": False}
+            if seq > len(self._group_log):
+                # A process can only be at most one entry ahead of the log;
+                # anything else is a protocol bug or a stale world — restart.
+                logger.warning(
+                    "GetGroupTask seq %d ahead of log %d (v%d)",
+                    seq, len(self._group_log), version,
+                )
+                return stale
+            resp = self.GetTask({"worker_id": self.group_worker_id(version)})
+            if resp["task"] is None and not resp["finished"]:
+                return {"task": None, "finished": False, "stale": False}
+            entry = {"task": resp["task"], "finished": resp["finished"]}
+            self._group_log.append(entry)
+            return dict(entry, stale=False)
 
     def job_finished(self) -> bool:
         """True when training tasks drained AND any pending/in-flight eval is done."""
@@ -192,8 +275,19 @@ class MasterServicer:
         self._known_workers.add(req["worker_id"])
         return self.rendezvous.membership()
 
+    def DeregisterWorker(self, req: dict) -> dict:
+        """Active leave.  A lockstep group member that failed a task calls
+        this before restarting: the version bump makes every peer resync
+        instead of wedging in a collective the failed member will never
+        join (and requeues the member's in-flight tasks)."""
+        return {"version": self.rendezvous.remove(req["worker_id"])}
+
     def Heartbeat(self, req: dict) -> dict:
-        return {"version": self.rendezvous.heartbeat(req["worker_id"])}
+        return {
+            "version": self.rendezvous.heartbeat(
+                req["worker_id"], req.get("version")
+            )
+        }
 
     def GetMembership(self, req: dict) -> dict:
         return self.rendezvous.membership()
@@ -224,9 +318,11 @@ class MasterServicer:
             name: getattr(self, name)
             for name in (
                 "GetTask",
+                "GetGroupTask",
                 "ReportTaskResult",
                 "ReportVersion",
                 "RegisterWorker",
+                "DeregisterWorker",
                 "Heartbeat",
                 "GetMembership",
                 "GetCheckpoint",

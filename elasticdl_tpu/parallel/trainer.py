@@ -29,6 +29,7 @@ from typing import Any, Callable, Dict, List, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from flax import struct
 from jax import lax
@@ -223,9 +224,29 @@ class Trainer:
         shardings = jax.tree.map(
             lambda s: NamedSharding(self.mesh, s), self._state_specs
         )
-        return jax.device_put(state, shardings)
+        procs = {d.process_index for d in self.mesh.devices.flat}
+        if len(procs) <= 1:
+            return jax.device_put(state, shardings)
+        # Multi-process mesh: device_put cannot target non-addressable
+        # devices.  Every process holds the same host-side state (init is
+        # deterministic from the shared seed; restores read the same
+        # checkpoint), so each fills in its own addressable shards.
+
+        def place(x, sh):
+            arr = np.asarray(jax.device_get(x))
+            return jax.make_array_from_callback(arr.shape, sh, lambda i: arr[i])
+
+        return jax.tree.map(place, state, shardings)
 
     def shard_batch(self, batch: Any) -> Any:
+        """Place a GLOBAL batch on the mesh, batch-dim sharded.
+
+        Single-process meshes device_put directly.  Multi-process meshes
+        (jax.distributed worlds) cannot device_put onto non-addressable
+        devices; every process feeds the same deterministic global batch and
+        contributes its own row range via
+        ``jax.make_array_from_process_local_data`` (SURVEY.md §3.5).
+        """
         n = self.mesh.devices.size
         leaves = jax.tree.leaves(batch)
         if leaves and leaves[0].shape[0] % n != 0:
@@ -233,7 +254,25 @@ class Trainer:
                 f"global batch {leaves[0].shape[0]} not divisible by mesh size {n}"
             )
         sharding = NamedSharding(self.mesh, P(self.axis_name))
-        return jax.device_put(batch, sharding)
+        procs = {d.process_index for d in self.mesh.devices.flat}
+        if len(procs) <= 1:
+            return jax.device_put(batch, sharding)
+
+        def to_global(x):
+            x = np.asarray(x)
+            # This process's contiguous row range under batch-dim sharding:
+            # the union of its addressable devices' index slices.
+            idx_map = sharding.addressable_devices_indices_map(x.shape)
+            starts = [s[0].start or 0 for s in idx_map.values()]
+            stops = [
+                x.shape[0] if s[0].stop is None else s[0].stop
+                for s in idx_map.values()
+            ]
+            return jax.make_array_from_process_local_data(
+                sharding, x[min(starts):max(stops)], x.shape
+            )
+
+        return jax.tree.map(to_global, batch)
 
     # ---- step builders ----
 

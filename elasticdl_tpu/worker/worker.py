@@ -101,7 +101,16 @@ class Worker:
         self.worker_id = worker_id
         self.spec = spec or load_model_spec_for_job(config)
         self._pool = list(devices) if devices is not None else list(jax.devices())
-        self._dpw = devices_per_worker or len(self._pool)
+        # Devices contributed per worker: in a real multi-host world each
+        # worker is one host, so its share is its LOCAL device count (the
+        # pool is global after jax.distributed.initialize); tests passing an
+        # explicit pool emulate elasticity over that pool instead.
+        if devices_per_worker:
+            self._dpw = devices_per_worker
+        elif devices is not None:
+            self._dpw = len(self._pool)
+        else:
+            self._dpw = len(jax.local_devices())
         self._poll = poll_interval_s
 
         self.trainer: Optional[Trainer] = None
@@ -109,6 +118,11 @@ class Worker:
         self._membership_version = -1
         self._rank = 0
         self._ranks: Dict[str, int] = {}
+        # Multi-host lockstep: all processes of the world walk the master's
+        # group task log in the same order (GetGroupTask seq counter); only
+        # rank 0 reports results.
+        self._group_mode = False
+        self._task_seq = 0
         self._ckpt: Optional[CheckpointManager] = None
         self._last_ckpt_step = 0
         self.reforms = 0  # elastic mesh re-formations (observability/tests)
@@ -139,6 +153,7 @@ class Worker:
         prev_ranks = self._ranks
         self._ranks = dict(membership["ranks"])
         self._rank = self._ranks.get(self.worker_id, 0)
+        self._group_mode = self.config.multihost and len(self._ranks) > 1
         if self.config.multihost and not initial:
             # The jax.distributed world is fixed per process (PJRT can't be
             # re-formed in-process): snapshot, then restart.  The pod
@@ -152,18 +167,39 @@ class Worker:
             # state, and gating on new rank would then silently lose all
             # progress since the last periodic checkpoint.  The lowest
             # previous-rank worker still present in the new membership saves.
+            #
+            # Only when the OLD world was single-process, though: in a
+            # multi-process world every Orbax save is a COLLECTIVE (all
+            # processes barrier; the primary writes), and the very reason the
+            # membership changed is usually that a peer died — a lone
+            # snapshot would deadlock in the barrier.  Multi-process worlds
+            # rely on their periodic checkpoints (which are collective).
+            was_group = self.config.multihost and len(prev_ranks) > 1
             survivors = set(prev_ranks) & set(self._ranks)
             saver = (
                 min(survivors, key=lambda w: prev_ranks[w]) if survivors else None
             )
             if (
-                self._ckpt is not None
+                not was_group
+                and self._ckpt is not None
                 and self.worker_id == saver
                 and self.state is not None
             ):
-                self._ckpt.save(
-                    int(self.state.step), jax.device_get(self.state), wait=True
-                )
+                try:
+                    step = int(self.state.step)
+                    self._ckpt.save(step, jax.device_get(self.state), wait=True)
+                    # Tell the master: the relaunched processes learn of the
+                    # snapshot via GetCheckpoint and resume from it instead
+                    # of re-training from the last PERIODIC checkpoint (or
+                    # scratch).
+                    self.master.call(
+                        "ReportCheckpoint",
+                        {"path": self._ckpt.directory, "step": step},
+                    )
+                except Exception:
+                    # A broken runtime must not block the restart itself —
+                    # the periodic checkpoint covers the resume.
+                    logger.exception("pre-restart snapshot failed; restarting anyway")
             raise WorkerRestartRequired(
                 f"membership v{version}: world changed to {world} hosts"
             )
@@ -196,7 +232,13 @@ class Worker:
         self.state = restored
 
     def _check_membership(self) -> None:
-        resp = self.master.call("Heartbeat", {"worker_id": self.worker_id})
+        # The heartbeat carries the version this worker has APPLIED: the
+        # master's lockstep task log withholds collective tasks until every
+        # member confirms the current topology (see RendezvousServer).
+        resp = self.master.call(
+            "Heartbeat",
+            {"worker_id": self.worker_id, "version": self._membership_version},
+        )
         if resp["version"] != self._membership_version:
             membership = self.master.call("GetMembership", {})
             self._apply_membership(membership)
@@ -209,7 +251,21 @@ class Worker:
         step = int(self.state.step)
         if step - self._last_ckpt_step < self.config.checkpoint_steps:
             return
-        if self._rank == 0:
+        if self._group_mode:
+            # Orbax saves are COLLECTIVE in a multi-process world: every
+            # process must call save (each writes its addressable shards and
+            # joins the commit barrier) — a rank-gated save would deadlock
+            # the group.  All processes run lockstep tasks, so they all
+            # reach the same step boundary.  Save the LIVE global arrays
+            # (device_get cannot read non-addressable shards).
+            self._ckpt.save(step, self.state)
+            self._last_ckpt_step = step
+            if self._rank == 0:
+                self.master.call(
+                    "ReportCheckpoint",
+                    {"path": self._ckpt.directory, "step": step},
+                )
+        elif self._rank == 0:
             self._ckpt.save(step, jax.device_get(self.state))
             self._last_ckpt_step = step
             self.master.call(
@@ -285,11 +341,19 @@ class Worker:
 
     # ---- main loop ----
 
-    def run(self) -> Dict[str, Any]:
-        membership = self.master.call(
-            "RegisterWorker",
-            {"worker_id": self.worker_id, "address": self._advertised_address()},
-        )
+    def run(self, membership: Optional[dict] = None) -> Dict[str, Any]:
+        """Main loop.  ``membership`` is the view returned by an EARLIER
+        RegisterWorker call (worker.main registers once, derives the
+        jax.distributed spec from that view, and passes it here) — a second
+        registration would race a concurrent join and silently absorb a
+        membership this process's fixed distributed world does not match.
+        Without it (single-process tests, in-process workers) we register
+        here."""
+        if membership is None:
+            membership = self.master.call(
+                "RegisterWorker",
+                {"worker_id": self.worker_id, "address": self._advertised_address()},
+            )
         self._apply_membership(membership, initial=True)
         if self.state is None:
             self.state = self.trainer.init_state(jax.random.key(0))
@@ -305,13 +369,32 @@ class Worker:
         tasks_done = 0
         while True:
             self._check_membership()
-            resp = self.master.call("GetTask", {"worker_id": self.worker_id})
+            if self._group_mode:
+                # Lockstep pull: every process of the world executes the same
+                # task (the jitted step is a collective over all their
+                # devices); the master's group log keys entries by seq.
+                resp = self.master.call(
+                    "GetGroupTask",
+                    {
+                        "worker_id": self.worker_id,
+                        "seq": self._task_seq,
+                        "version": self._membership_version,
+                    },
+                )
+                if resp.get("stale"):
+                    # World changed under us: the next membership check
+                    # raises WorkerRestartRequired.
+                    time.sleep(self._poll)
+                    continue
+            else:
+                resp = self.master.call("GetTask", {"worker_id": self.worker_id})
             if resp["task"] is None:
                 if resp["finished"]:
                     break
                 time.sleep(self._poll)
                 continue
             task = Task.from_dict(resp["task"])
+            self._task_seq += 1
             report = {
                 "worker_id": self.worker_id,
                 "task_id": task.task_id,
@@ -341,18 +424,47 @@ class Worker:
             except Exception:
                 logger.exception("task %d failed", task.task_id)
                 report["success"] = False
-            self.master.call("ReportTaskResult", report)
+            if self._group_mode and not report["success"]:
+                # A member that failed a lockstep task is DESYNCHRONIZED:
+                # its peers' next collective (step or checkpoint barrier)
+                # would wedge waiting for it.  Requeue the task, actively
+                # leave the membership (the version bump resyncs the peers),
+                # and restart.
+                for call, payload in (
+                    ("ReportTaskResult", report),
+                    ("DeregisterWorker", {"worker_id": self.worker_id}),
+                ):
+                    try:
+                        self.master.call(call, payload)
+                    except Exception:  # master unreachable: peers will
+                        pass           # still reap us via heartbeats
+                raise WorkerRestartRequired(
+                    f"task {task.task_id} failed in lockstep mode; "
+                    "deregistered for group resync"
+                )
+            if not self._group_mode or self._rank == 0:
+                # In lockstep mode every process ran the task's collectives,
+                # but exactly one report must hit the master's queues.
+                self.master.call("ReportTaskResult", report)
             if report["success"]:
                 tasks_done += 1
                 self._maybe_checkpoint()
 
-        # Final checkpoint so a completed job is resumable/servable.
-        if self._ckpt is not None and self._rank == 0 and self.state is not None:
-            self._ckpt.save(int(self.state.step), jax.device_get(self.state), wait=True)
-            self.master.call(
-                "ReportCheckpoint",
-                {"path": self._ckpt.directory, "step": int(self.state.step)},
-            )
+        # Final checkpoint so a completed job is resumable/servable.  In
+        # group mode the save is collective (see _maybe_checkpoint); all
+        # processes reach this point together because the finished marker is
+        # a logged lockstep entry.
+        if self._ckpt is not None and self.state is not None and (
+            self._group_mode or self._rank == 0
+        ):
+            step = int(self.state.step)
+            payload = self.state if self._group_mode else jax.device_get(self.state)
+            self._ckpt.save(step, payload, wait=True)
+            if self._rank == 0:
+                self.master.call(
+                    "ReportCheckpoint",
+                    {"path": self._ckpt.directory, "step": step},
+                )
         return {
             "tasks_done": tasks_done,
             "step": int(self.state.step) if self.state is not None else 0,
