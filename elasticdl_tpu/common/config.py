@@ -70,9 +70,11 @@ class JobConfig:
     learning_rate: float = 1e-3
 
     # --- cluster shape ---
+    # (The reference's --num_ps_shards / --use_tpu flags are intentionally
+    # absent: the HBM-mesh design shards embeddings over the whole mesh by
+    # construction, and the platform comes from the environment/driver —
+    # neither flag could change behavior here, and dead flags lie.)
     num_workers: int = 1
-    num_ps_shards: int = 0  # 0 = shard embeddings over all mesh devices
-    use_tpu: bool = True
     # How the master launches workers: "process" (local subprocesses),
     # "kubernetes" (GKE TPU pods), or "fake" (tests).  The reference's
     # equivalent choice is implicit in running on k8s at all.

@@ -105,6 +105,10 @@ class Master:
             evaluation=self.evaluation,
             final_eval=self.evaluation is not None,
             metrics_writer=self.metrics_writer,
+            max_steps=config.max_steps,
+            # --evaluation_steps=0 means "eval at each epoch end" (the
+            # reference's semantics); >0 means interval-based rounds.
+            epoch_end_eval=config.evaluation_steps == 0,
         )
         self.server = MasterServer(
             self.servicer, port=port, advertise_host=self._advertise_host(config)
@@ -194,6 +198,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         config = JobConfig.from_env()
     except KeyError:
         config = parse_args(argv)
+    from elasticdl_tpu.common.log_utils import set_level
+
+    set_level(config.log_level)
     master = Master(config)
     status = master.run()
     return 0 if not status.get("abandoned") else 1

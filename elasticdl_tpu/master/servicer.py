@@ -32,7 +32,11 @@ from typing import Dict, Optional
 import grpc
 
 from elasticdl_tpu.common.log_utils import get_logger
-from elasticdl_tpu.common.rpc import SERVICE_NAME, make_generic_handler
+from elasticdl_tpu.common.rpc import (
+    MASTER_SCHEMAS,
+    SERVICE_NAME,
+    make_generic_handler,
+)
 from elasticdl_tpu.master.evaluation_service import EvaluationService
 from elasticdl_tpu.master.rendezvous import RendezvousServer
 from elasticdl_tpu.master.task_dispatcher import (
@@ -51,11 +55,27 @@ class MasterServicer:
         evaluation: Optional[EvaluationService] = None,
         final_eval: bool = False,
         metrics_writer=None,
+        max_steps: int = 0,
+        epoch_end_eval: bool = False,
     ):
         self.dispatcher = dispatcher
         self.rendezvous = rendezvous or RendezvousServer()
         self.evaluation = evaluation
         self.metrics_writer = metrics_writer
+        # --max_steps: stop dispatching once the model version reaches it
+        # (0 = until tasks exhausted).  Enforced in _bump_version.
+        self._max_steps = max_steps
+        self._max_steps_hit = False
+        # --evaluation_steps=0 ("eval at epoch end only"): an eval round at
+        # every epoch boundary, driven by the dispatcher's epoch-end events.
+        # Boundaries that fire while a round is in flight queue here
+        # (FIFO of is_final flags) and retry from GetTask.
+        self._pending_epoch_evals: list = []
+        self._epoch_end_eval = (
+            epoch_end_eval and evaluation is not None and evaluation.enabled()
+        )
+        if self._epoch_end_eval:
+            dispatcher.set_epoch_end_callback(self._on_epoch_end)
         self._written_eval_rounds = 0
         self._lock = threading.Lock()
         self._model_version = 0
@@ -121,6 +141,8 @@ class MasterServicer:
 
     def GetTask(self, req: dict) -> dict:
         worker_id = req["worker_id"]
+        if self._epoch_end_eval:
+            self._drain_pending_epoch_evals()
         # Eval rounds preempt training tasks so metrics snapshot a consistent
         # model version quickly (reference behavior: eval tasks share the queue
         # with priority).
@@ -210,6 +232,9 @@ class MasterServicer:
             return True
         if self._final_eval and not self._final_eval_done:
             return False
+        with self._lock:
+            if self._pending_epoch_evals:
+                return False  # queued epoch-boundary rounds still owed
         return not self.evaluation.round_in_flight()
 
     def ReportTaskResult(self, req: dict) -> dict:
@@ -263,10 +288,46 @@ class MasterServicer:
         self._bump_version(int(req["model_version"]))
         return {}
 
+    def _on_epoch_end(self, epoch: int, final: bool) -> None:
+        """Epoch-boundary eval (--evaluation_steps=0).  A boundary whose
+        round cannot start yet (previous round still in flight — routine,
+        since eval and training tasks run concurrently) is QUEUED and
+        retried from GetTask, never dropped; job_finished holds the job open
+        until the queue drains.  The final epoch's round doubles as the
+        end-of-job eval."""
+        with self._lock:
+            self._pending_epoch_evals.append(final)
+        logger.info("epoch %d ended (final=%s): eval round queued", epoch, final)
+        self._drain_pending_epoch_evals()
+
+    def _drain_pending_epoch_evals(self) -> None:
+        with self._lock:
+            if not self._pending_epoch_evals:
+                return
+            version = self._model_version
+            final = self._pending_epoch_evals[0]
+        if not self.evaluation.trigger(version):
+            return  # round in flight; retried on a later GetTask
+        with self._lock:
+            self._pending_epoch_evals.pop(0)
+            if final:
+                self._final_eval_done = True
+
     def _bump_version(self, version: int) -> None:
         with self._lock:
             self._model_version = max(self._model_version, version)
             current = self._model_version
+        if (
+            self._max_steps
+            and current >= self._max_steps
+            and not self._max_steps_hit
+        ):
+            self._max_steps_hit = True
+            logger.info(
+                "max_steps %d reached (version %d): draining task queue",
+                self._max_steps, current,
+            )
+            self.dispatcher.stop()
         if self.evaluation is not None:
             self.evaluation.maybe_trigger(current)
 
@@ -345,7 +406,11 @@ class MasterServer:
         self.servicer = servicer
         self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
         self._server.add_generic_rpc_handlers(
-            (make_generic_handler(SERVICE_NAME, servicer.method_table()),)
+            (
+                make_generic_handler(
+                    SERVICE_NAME, servicer.method_table(), schemas=MASTER_SCHEMAS
+                ),
+            )
         )
         self.port = self._server.add_insecure_port(f"[::]:{port}")
         # The host workers dial; for cluster deployments this must be a

@@ -16,7 +16,7 @@ import dataclasses
 import threading
 import time
 from collections import deque
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from elasticdl_tpu.data.reader import Shard
 
@@ -87,7 +87,17 @@ class TaskDispatcher:
         self._next_task_id = 0
         self._epoch = -1  # _refill brings it to 0
         self._finished = not self._shards
+        # Epoch-boundary events: (epoch, is_final) pairs queued under the
+        # lock by _refill and delivered OUTSIDE it (the callback may start an
+        # eval round, which has its own locks).  The master wires the
+        # callback for the reference's "eval at epoch end" mode
+        # (--evaluation_steps=0).
+        self._on_epoch_end: Optional[Callable[[int, bool], None]] = None
+        self._pending_epoch_end: List[Tuple[int, bool]] = []
         self._refill()
+
+    def set_epoch_end_callback(self, fn: Callable[[int, bool], None]) -> None:
+        self._on_epoch_end = fn
 
     # -- internal --
 
@@ -95,15 +105,30 @@ class TaskDispatcher:
         """Start the next epoch if the current one is exhausted."""
         if self._finished or self._todo or self._doing:
             return
+        prev = self._epoch
         if self._epoch + 1 >= self._num_epochs:
             self._finished = True
+            if prev >= 0:
+                self._pending_epoch_end.append((prev, True))
             return
+        if prev >= 0:
+            self._pending_epoch_end.append((prev, False))
         self._epoch += 1
         for shard in self._shards:
             self._todo.append(
                 Task(self._next_task_id, shard, self._task_type, self._epoch)
             )
             self._next_task_id += 1
+
+    def _fire_epoch_end(self) -> None:
+        """Deliver queued epoch-boundary events (call with the lock RELEASED)."""
+        while True:
+            with self._lock:
+                if not self._pending_epoch_end:
+                    return
+                epoch, final = self._pending_epoch_end.pop(0)
+            if self._on_epoch_end is not None:
+                self._on_epoch_end(epoch, final)
 
     # -- worker-facing API (via servicer) --
 
@@ -116,11 +141,12 @@ class TaskDispatcher:
         with self._lock:
             self._requeue_timed_out()
             self._refill()
-            if not self._todo:
-                return None
-            task = self._todo.popleft()
-            self._doing[task.task_id] = _Doing(task, worker_id, self._clock())
-            return task
+            task = None
+            if self._todo:
+                task = self._todo.popleft()
+                self._doing[task.task_id] = _Doing(task, worker_id, self._clock())
+        self._fire_epoch_end()
+        return task
 
     def report(self, task_id: int, success: bool, worker_id: str = "") -> bool:
         """Record a task result; requeue on failure.  Returns False for an
@@ -142,7 +168,8 @@ class TaskDispatcher:
                     # data, codec mismatch) must not stall the job forever.
                     self._abandoned += 1
             self._refill()
-            return True
+        self._fire_epoch_end()
+        return True
 
     # -- elasticity hooks --
 
@@ -165,6 +192,14 @@ class TaskDispatcher:
         ]
         for tid in stale:
             self._todo.appendleft(self._doing.pop(tid).task)
+
+    def stop(self) -> None:
+        """Stop handing out new tasks (reference: --max_steps reached).
+        In-flight tasks still report normally; ``finished()`` turns True once
+        they drain.  No further epochs refill."""
+        with self._lock:
+            self._todo.clear()
+            self._finished = True
 
     # -- introspection --
 

@@ -140,10 +140,17 @@ def _loss(logits, batch):
     ).mean()
 
 
-def _metrics(logits, batch):
+def _metrics(logits, batch, mask=None):
+    from elasticdl_tpu.models.metrics import masked_mean
+
     return {
-        "accuracy": (jnp.argmax(logits, -1) == batch["labels"]).mean(),
-        "loss": _loss(logits, batch),
+        "accuracy": masked_mean(jnp.argmax(logits, -1) == batch["labels"], mask),
+        "loss": masked_mean(
+            optax.softmax_cross_entropy_with_integer_labels(
+                logits, batch["labels"]
+            ),
+            mask,
+        ),
     }
 
 

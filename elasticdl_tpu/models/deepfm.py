@@ -137,8 +137,8 @@ def _loss(logits, batch):
     return bce_loss(logits, batch["labels"])
 
 
-def _metrics(logits, batch):
-    return binary_metrics(logits, batch["labels"])
+def _metrics(logits, batch, mask=None):
+    return binary_metrics(logits, batch["labels"], mask)
 
 
 def _example_batch(batch_size: int):

@@ -75,11 +75,16 @@ def _loss(logits, batch):
     return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
 
 
-def _metrics(logits, batch) -> Dict[str, Any]:
+def _metrics(logits, batch, mask=None) -> Dict[str, Any]:
+    from elasticdl_tpu.models.metrics import masked_mean
+
     labels = batch["labels"]
     return {
-        "accuracy": (jnp.argmax(logits, -1) == labels).mean(),
-        "loss": _loss(logits, batch),
+        "accuracy": masked_mean(jnp.argmax(logits, -1) == labels, mask),
+        "loss": masked_mean(
+            optax.softmax_cross_entropy_with_integer_labels(logits, labels),
+            mask,
+        ),
     }
 
 

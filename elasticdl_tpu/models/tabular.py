@@ -47,19 +47,23 @@ def log_normalize(dense: jax.Array) -> jax.Array:
     return jnp.log1p(jnp.maximum(dense.astype(jnp.float32), 0.0))
 
 
-def binary_metrics(logits: jax.Array, labels: jax.Array) -> dict:
-    """Loss/accuracy/calibration for binary CTR-style tasks."""
+def binary_metrics(logits: jax.Array, labels: jax.Array, mask=None) -> dict:
+    """Loss/accuracy/calibration for binary CTR-style tasks (mask: eval
+    tail padding — see models/metrics.py)."""
+    from elasticdl_tpu.models.metrics import masked_mean
+
     prob = jax.nn.sigmoid(logits)
     pred = (prob >= 0.5).astype(jnp.int32)
     labels_f = labels.astype(jnp.float32)
-    bce = jnp.mean(
+    bce_per_example = (
         jnp.maximum(logits, 0) - logits * labels_f + jnp.log1p(jnp.exp(-jnp.abs(logits)))
     )
     return {
-        "loss": bce,
-        "accuracy": jnp.mean((pred == labels).astype(jnp.float32)),
+        "loss": masked_mean(bce_per_example, mask),
+        "accuracy": masked_mean(pred == labels, mask),
         # mean(prob)/mean(label): ~1.0 when calibrated, a standard CTR sanity metric
-        "calibration": jnp.mean(prob) / jnp.maximum(jnp.mean(labels_f), 1e-6),
+        "calibration": masked_mean(prob, mask)
+        / jnp.maximum(masked_mean(labels_f, mask), 1e-6),
     }
 
 

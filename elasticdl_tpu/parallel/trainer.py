@@ -25,6 +25,7 @@ are left alone.  The two paths are consistent without rescaling.
 
 from __future__ import annotations
 
+import inspect
 from typing import Any, Callable, Dict, List, Tuple
 
 import jax
@@ -361,9 +362,26 @@ def build_eval_step(
 ) -> Callable:
     axis = ctx.axis_name
     assert axis is not None
+    # Tail-chunk correctness: the worker wrap-pads the last eval chunk to the
+    # static minibatch size and marks real rows in ``__mask__``.  Metrics
+    # functions that accept a mask compute means over real examples only;
+    # the cross-device aggregate is psum(local_mean * local_count) /
+    # psum(local_count), exact under uneven per-device real counts.  Metrics
+    # without a mask parameter (user models) fall back to plain pmean over
+    # the padded batch.
+    wants_mask = "mask" in inspect.signature(spec.metrics).parameters
 
     def local_eval(state: TrainState, batch):
+        batch = dict(batch)
+        mask = batch.pop("__mask__", None)
         out = spec.apply(state.params, batch, train=False, ctx=ctx)
+        if mask is not None and wants_mask:
+            metrics = spec.metrics(out, batch, mask=mask)
+            count = jnp.sum(mask.astype(jnp.float32))
+            total = jnp.maximum(lax.psum(count, axis), 1e-12)
+            return {
+                k: lax.psum(v * count, axis) / total for k, v in metrics.items()
+            }
         return {k: lax.pmean(v, axis) for k, v in spec.metrics(out, batch).items()}
 
     mapped = shard_map(

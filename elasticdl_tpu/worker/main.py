@@ -70,6 +70,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         config = parse_args(argv)
     if not config.master_addr:
         raise SystemExit("worker needs --master_addr (or config via env)")
+    from elasticdl_tpu.common.log_utils import set_level
+
+    set_level(config.log_level)
     worker_id = os.environ.get("ELASTICDL_WORKER_ID", f"worker-{os.getpid()}")
 
     master = RpcMasterProxy(config.master_addr)

@@ -40,12 +40,17 @@ logger = get_logger("worker")
 
 
 class DirectMasterProxy:
-    """In-process master (the reference's no-cluster test pattern)."""
+    """In-process master (the reference's no-cluster test pattern).  Applies
+    the same wire schemas as the gRPC path so in-process tests catch
+    contract drift."""
 
     def __init__(self, servicer):
         self._s = servicer
 
     def call(self, method: str, request: dict) -> dict:
+        from elasticdl_tpu.common.rpc import MASTER_SCHEMAS, validate_message
+
+        validate_message(method, request, MASTER_SCHEMAS)
         return self._s.method_table()[method](request)
 
 
@@ -295,13 +300,22 @@ class Worker:
 
     def _run_training_task(self, task: Task) -> Dict[str, float]:
         records = list(self.reader.read_records(task.shard))
-        metrics: Dict[str, Any] = {}
+        sums: Dict[str, float] = {}
+        n_batches = 0
         for chunk, _ in _minibatches(records, self.config.minibatch_size, True):
             batch = self.spec.feed(chunk)
             self.state, metrics = self.trainer.train_step(
                 self.state, self.trainer.shard_batch(batch)
             )
-        return {k: float(v) for k, v in metrics.items()}
+            # Aggregate across the task's minibatches (equal sizes — tails
+            # wrap-pad) instead of reporting only the last one's metrics.
+            # Accumulate the DEVICE scalars: a float() here would block on
+            # every step and kill async-dispatch pipelining; one transfer at
+            # task end suffices.
+            n_batches += 1
+            for k, v in metrics.items():
+                sums[k] = sums.get(k, 0.0) + v
+        return {k: float(s) / max(n_batches, 1) for k, s in sums.items()}
 
     def _run_evaluation_task(self, task: Task) -> tuple:
         records = list(self.reader.read_records(task.shard))
@@ -310,7 +324,13 @@ class Worker:
         for chunk, true_count in _minibatches(
             records, self.config.minibatch_size, False
         ):
-            batch = self.spec.feed(chunk)
+            batch = dict(self.spec.feed(chunk))
+            # Real-vs-padding mask for the wrap-padded tail: metrics count
+            # only real rows (see models/metrics.py) — without it the
+            # duplicated examples were over-weighted.
+            batch["__mask__"] = (
+                np.arange(self.config.minibatch_size) < true_count
+            ).astype(np.float32)
             metrics = self.trainer.eval_step(
                 self.state, self.trainer.shard_batch(batch)
             )

@@ -1,0 +1,89 @@
+"""Wire-contract schemas (VERDICT r2 Missing #5): malformed requests fail AT
+THE BOUNDARY — server aborts INVALID_ARGUMENT naming the field, client
+raises before the wire — instead of dying as a KeyError deep in a handler."""
+
+import grpc
+import pytest
+
+from elasticdl_tpu.common.rpc import (
+    MASTER_SCHEMAS,
+    JsonRpcClient,
+    SchemaError,
+    validate_message,
+)
+from elasticdl_tpu.master.servicer import MasterServer, MasterServicer
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+
+
+def test_schema_table_matches_method_table():
+    servicer = MasterServicer(TaskDispatcher([]))
+    assert set(servicer.method_table()) == set(MASTER_SCHEMAS)
+
+
+def test_validate_message_reports_all_problems():
+    with pytest.raises(SchemaError, match="unknown method"):
+        validate_message("Bogus", {}, MASTER_SCHEMAS)
+    with pytest.raises(SchemaError, match="worker_id"):
+        validate_message("GetTask", {}, MASTER_SCHEMAS)
+    with pytest.raises(SchemaError, match="must be int"):
+        validate_message(
+            "GetGroupTask",
+            {"worker_id": "w", "seq": "zero", "version": 1},
+            MASTER_SCHEMAS,
+        )
+    # multiple violations all named
+    with pytest.raises(SchemaError, match="task_id.*success|success.*task_id"):
+        validate_message(
+            "ReportTaskResult", {"worker_id": "w"}, MASTER_SCHEMAS
+        )
+    # optional fields: absent ok, wrong type rejected
+    validate_message(
+        "Heartbeat", {"worker_id": "w"}, MASTER_SCHEMAS
+    )
+    with pytest.raises(SchemaError, match="version"):
+        validate_message(
+            "Heartbeat", {"worker_id": "w", "version": "v2"}, MASTER_SCHEMAS
+        )
+    # unknown extra fields pass (forward compatibility)
+    validate_message(
+        "GetTask", {"worker_id": "w", "future_field": 1}, MASTER_SCHEMAS
+    )
+    # bool is NOT an int at this boundary (bool subclasses int in Python)
+    with pytest.raises(SchemaError, match="model_version"):
+        validate_message(
+            "ReportVersion", {"model_version": True}, MASTER_SCHEMAS
+        )
+    validate_message(  # but bool fields still take bools
+        "ReportTaskResult",
+        {"worker_id": "w", "task_id": 1, "success": True},
+        MASTER_SCHEMAS,
+    )
+
+
+def test_malformed_request_fails_at_grpc_boundary():
+    servicer = MasterServicer(TaskDispatcher([]))
+    server = MasterServer(servicer, port=0).start()
+    try:
+        client = JsonRpcClient(server.address)
+        client.wait_ready(10)
+        # client-side validation fires first, in the caller's stack frame
+        with pytest.raises(SchemaError, match="worker_id"):
+            client.call("GetTask", {})
+        # bypass client validation: the SERVER enforces the same schema
+        raw = JsonRpcClient(server.address, schemas={})
+        with pytest.raises(SchemaError, match="unknown method"):
+            raw.call("GetTask", {})  # empty table -> everything unknown
+        unchecked = JsonRpcClient(server.address, schemas=None)
+        unchecked._schemas = None
+        with pytest.raises(grpc.RpcError) as err:
+            unchecked.call("GetTask", {"worker_id": 42})
+        assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        assert "worker_id" in err.value.details()
+        # unknown methods are structured errors, not hangs or crashes
+        with pytest.raises(grpc.RpcError) as err:
+            unchecked.call("NoSuchMethod", {})
+        assert err.value.code() == grpc.StatusCode.UNIMPLEMENTED
+        # and a well-formed call still works end to end
+        assert client.call("JobStatus", {})["finished"] is True
+    finally:
+        server.stop()
