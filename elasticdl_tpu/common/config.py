@@ -103,6 +103,11 @@ class JobConfig:
     # --- master / control plane ---
     master_addr: str = ""  # host:port of the master gRPC service
     task_timeout_s: float = 600.0
+    # How long the master waits after the job finishes for workers to exit on
+    # their own (they are writing final checkpoints — orbax + host-tier
+    # snapshots); the teardown then proceeds regardless.  Raise for jobs
+    # whose final snapshot is large.
+    shutdown_grace_s: float = 120.0
 
     # --- observability ---
     log_level: str = "INFO"

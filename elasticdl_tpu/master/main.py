@@ -180,6 +180,17 @@ class Master:
                             "all worker pods terminated before the job finished"
                         )
                 time.sleep(poll_interval_s)
+            # Grace period (--shutdown_grace_s): workers that just learned
+            # the job is finished are still writing their FINAL checkpoint
+            # (orbax + host-tier store snapshots); tearing the fleet down
+            # immediately would kill them mid-write.  They exit on their own
+            # right after, which ends the wait early.
+            deadline = time.monotonic() + self.config.shutdown_grace_s
+            while (
+                not self.pod_manager.all_finished()
+                and time.monotonic() < deadline
+            ):
+                time.sleep(poll_interval_s)
             status = self.servicer.JobStatus({})
             logger.info("job finished: %s", status)
             return status

@@ -87,6 +87,7 @@ class TaskDispatcher:
         self._next_task_id = 0
         self._epoch = -1  # _refill brings it to 0
         self._finished = not self._shards
+        self._stopped = False  # stop(): draining, nothing requeues
         # Epoch-boundary events: (epoch, is_final) pairs queued under the
         # lock by _refill and delivered OUTSIDE it (the callback may start an
         # eval round, which has its own locks).  The master wires the
@@ -158,6 +159,11 @@ class TaskDispatcher:
                 return False
             if success:
                 self._done_count += 1
+            elif self._stopped:
+                # Draining past --max_steps: a failed in-flight task is
+                # dropped, not requeued — requeueing would re-open dispatch
+                # and train past the configured limit.
+                self._abandoned += 1
             else:
                 fails = self._failed_counts.get(task_id, 0) + 1
                 self._failed_counts[task_id] = fails
@@ -175,12 +181,14 @@ class TaskDispatcher:
 
     def recover_tasks(self, worker_id: str) -> List[Task]:
         """Requeue every in-flight task of a dead worker (PodManager calls
-        this on a pod-failure event; §3.2 'elasticity core')."""
+        this on a pod-failure event; §3.2 'elasticity core').  After stop()
+        the tasks are released but NOT requeued (draining)."""
         with self._lock:
             lost = [d.task for d in self._doing.values() if d.worker_id == worker_id]
             for task in lost:
                 del self._doing[task.task_id]
-                self._todo.appendleft(task)
+                if not self._stopped:
+                    self._todo.appendleft(task)
             return lost
 
     def _requeue_timed_out(self) -> None:
@@ -191,15 +199,19 @@ class TaskDispatcher:
             if now - d.handed_at > self._timeout
         ]
         for tid in stale:
-            self._todo.appendleft(self._doing.pop(tid).task)
+            task = self._doing.pop(tid).task
+            if not self._stopped:
+                self._todo.appendleft(task)
 
     def stop(self) -> None:
         """Stop handing out new tasks (reference: --max_steps reached).
         In-flight tasks still report normally; ``finished()`` turns True once
-        they drain.  No further epochs refill."""
+        they drain.  Sticky: no refill, and failed/timed-out/recovered tasks
+        do not requeue afterwards."""
         with self._lock:
             self._todo.clear()
             self._finished = True
+            self._stopped = True
 
     # -- introspection --
 

@@ -26,11 +26,12 @@ import jax.numpy as jnp
 import optax
 
 from elasticdl_tpu.data.codecs import criteo_feed
-from elasticdl_tpu.models.spec import EmbeddingTableSpec, ModelSpec
+from elasticdl_tpu.models.spec import EmbeddingTableSpec, HostTableIO, ModelSpec
 from elasticdl_tpu.models.tabular import (
     bce_loss,
     binary_metrics,
     fuse_feature_ids,
+    fuse_feature_ids_np,
     log_normalize,
 )
 from elasticdl_tpu.ops.embedding import (
@@ -43,11 +44,15 @@ NUM_DENSE = 13
 NUM_CAT = 26
 
 
+HOST_FM_KEY = "__host__fm_table"
+
+
 def _init_params(
     rng: jax.Array,
     buckets_per_feature: int,
     embedding_dim: int,
     hidden: tuple,
+    host_tier: bool = False,
 ) -> Dict[str, Any]:
     vocab = NUM_CAT * buckets_per_feature
     ks = jax.random.split(rng, 4 + len(hidden))
@@ -61,15 +66,7 @@ def _init_params(
     # lane-packed — see ops/embedding.py: whole-physical-row gathers/
     # scatters are the TPU fast path (flat-slice layout hit a serial
     # per-row loop).
-    fm_logical = jnp.concatenate(
-        [
-            jax.random.normal(ks[0], (vocab, embedding_dim)) * 0.01,
-            jnp.zeros((vocab, 1), jnp.float32),
-        ],
-        axis=-1,
-    )
     params: Dict[str, Any] = {
-        "fm_table": pack_table(fm_logical, embedding_dim + 1),
         # Replicated dense params (the "allreduce" part).
         "dense_linear": {
             "w": jnp.zeros((NUM_DENSE, 1), jnp.float32),
@@ -77,6 +74,17 @@ def _init_params(
         },
         "mlp": {},
     }
+    if not host_tier:
+        # Host-tier mode keeps NO device table: rows live in the native C++
+        # store (lazy, per-id) and arrive through the batch.
+        fm_logical = jnp.concatenate(
+            [
+                jax.random.normal(ks[0], (vocab, embedding_dim)) * 0.01,
+                jnp.zeros((vocab, 1), jnp.float32),
+            ],
+            axis=-1,
+        )
+        params["fm_table"] = pack_table(fm_logical, embedding_dim + 1)
     in_dim = NUM_CAT * embedding_dim + NUM_DENSE
     for i, width in enumerate(hidden):
         params["mlp"][f"layer{i}"] = {
@@ -101,10 +109,17 @@ def _apply(
     compute_dtype=jnp.bfloat16,
     **_,
 ):
-    ids = fuse_feature_ids(batch["cat"], buckets_per_feature)  # [b, 26]
     dense = log_normalize(batch["dense"])  # [b, 13] f32
 
-    vecs = embedding_lookup(params["fm_table"], ids, ctx, dim=embedding_dim + 1)
+    if HOST_FM_KEY in batch:
+        # Host-tier: vectors were pulled from the C++ store and injected by
+        # the trainer; their cotangents flow back out as sparse grads.
+        vecs = batch[HOST_FM_KEY]  # [b, 26, dim+1]
+    else:
+        ids = fuse_feature_ids(batch["cat"], buckets_per_feature)  # [b, 26]
+        vecs = embedding_lookup(
+            params["fm_table"], ids, ctx, dim=embedding_dim + 1
+        )
     emb, lin = vecs[..., :embedding_dim], vecs[..., embedding_dim]  # [b,26,d],[b,26]
 
     emb = emb.astype(compute_dtype)
@@ -155,7 +170,13 @@ def model_spec(
     buckets_per_feature: int = 65536,
     embedding_dim: int = 8,
     hidden: Any = (400, 400),
+    host_tier: Any = "auto",
 ) -> ModelSpec:
+    """``host_tier``: True places the FM table in the native host store
+    (ps/host_store) instead of HBM; "auto" promotes it when the padded table
+    plus Adam moments would crowd a chip's HBM (ops.embedding guard) — the
+    reference's external gRPC-PS tier, for vocabularies beyond mesh memory.
+    """
     if isinstance(hidden, (list, tuple)):
         hidden = tuple(int(h) for h in hidden)
     else:  # "400,400" via --model_params
@@ -163,6 +184,11 @@ def model_spec(
     dtype = jnp.dtype(compute_dtype)
     vocab = NUM_CAT * buckets_per_feature
     dim = embedding_dim
+    if host_tier == "auto":
+        from elasticdl_tpu.ops.embedding import exceeds_hbm_guard
+
+        host_tier = exceeds_hbm_guard(vocab, dim + 1)
+    host_tier = bool(host_tier)
     return ModelSpec(
         name="deepfm",
         init=functools.partial(
@@ -170,6 +196,7 @@ def model_spec(
             buckets_per_feature=buckets_per_feature,
             embedding_dim=dim,
             hidden=hidden,
+            host_tier=host_tier,
         ),
         apply=functools.partial(
             _apply,
@@ -180,9 +207,35 @@ def model_spec(
         loss=_loss,
         metrics=_metrics,
         optimizer=optax.adam(learning_rate),
-        embedding_tables=[
-            EmbeddingTableSpec(path=("fm_table",), vocab_size=vocab, dim=dim + 1),
-        ],
+        embedding_tables=(
+            []
+            if host_tier
+            else [
+                EmbeddingTableSpec(
+                    path=("fm_table",), vocab_size=vocab, dim=dim + 1
+                )
+            ]
+        ),
+        host_io=(
+            {
+                HOST_FM_KEY: HostTableIO(
+                    ids_fn=functools.partial(
+                        _host_ids, buckets_per_feature=buckets_per_feature
+                    ),
+                    dim=dim + 1,
+                    optimizer="adagrad",
+                    learning_rate=learning_rate * 10,
+                    init_scale=0.01,
+                )
+            }
+            if host_tier
+            else {}
+        ),
         feed=criteo_feed,
         example_batch=_example_batch,
     )
+
+
+def _host_ids(batch, buckets_per_feature: int):
+    """Host-side (numpy) fused ids — identical to the on-device hash."""
+    return fuse_feature_ids_np(batch["cat"], buckets_per_feature)

@@ -46,6 +46,28 @@ class EmbeddingTableSpec:
     dim: int
 
 
+@dataclasses.dataclass(frozen=True)
+class HostTableIO:
+    """One HOST-TIER embedding table: rows live in the native C++ store
+    (``ps/host_store.HostEmbeddingStore``) on the worker host, not in HBM —
+    the reference's external-PS tier, for tables too large for the mesh.
+
+    Per step the trainer pulls the batch's rows (``ids_fn`` computes the ids
+    host-side in numpy, matching the model's on-device id math bit-for-bit),
+    injects them into the batch under the table's key, differentiates the
+    jitted step with respect to the injected array, and pushes the sparse
+    cotangents back; the store applies its own optimizer per distinct id
+    with duplicates pre-accumulated (IndexedSlices semantics, server-side —
+    SURVEY.md §2 #10).
+    """
+
+    ids_fn: Callable[[Batch], Any]  # numpy batch -> numpy ids [b, F]
+    dim: int
+    optimizer: str = "adagrad"
+    learning_rate: float = 0.01
+    init_scale: float = 0.05
+
+
 @dataclasses.dataclass
 class ModelSpec:
     name: str
@@ -58,6 +80,10 @@ class ModelSpec:
     embedding_tables: List[EmbeddingTableSpec] = dataclasses.field(
         default_factory=list
     )
+    # Host-tier tables: batch key -> HostTableIO.  The model's apply reads
+    # the injected vectors from the batch under the key instead of looking
+    # up a params table.
+    host_io: Dict[str, "HostTableIO"] = dataclasses.field(default_factory=dict)
     # Example batch (tiny) for compile checks / shape inference.
     example_batch: Optional[Callable[[int], Batch]] = None
 

@@ -42,6 +42,19 @@ def fuse_feature_ids(cat_ids: jax.Array, buckets_per_feature: int) -> jax.Array:
     return hashed + offsets
 
 
+def fuse_feature_ids_np(cat_ids, buckets_per_feature: int):
+    """Numpy twin of :func:`fuse_feature_ids` (bit-for-bit identical ids) —
+    host-tier pulls compute ids on the host before the jitted step."""
+    import numpy as np
+
+    ids = np.asarray(cat_ids)
+    h = ids.astype(np.uint32) * np.uint32(2654435761)
+    h ^= h >> np.uint32(16)
+    hashed = (h % np.uint32(buckets_per_feature)).astype(np.int64)
+    offsets = np.arange(ids.shape[-1], dtype=np.int64) * buckets_per_feature
+    return hashed + offsets
+
+
 def log_normalize(dense: jax.Array) -> jax.Array:
     """log(1+x) for non-negative numeric features (standard Criteo recipe)."""
     return jnp.log1p(jnp.maximum(dense.astype(jnp.float32), 0.0))

@@ -102,6 +102,30 @@ LANES = 128
 # params or optimizer state).
 PHYSICAL_ROW_MULTIPLE = 256
 
+# HBM guard for auto host-tier promotion: a table whose padded storage plus
+# Adam moments (3x) would crowd a v5e's 16 GiB HBM (shared with activations
+# and the dense model) belongs on the host tier (ps/host_store) instead of
+# the mesh.  Per-DEVICE cost is bytes/n at mesh size n; the guard is
+# conservative for n=1 (the single-chip bench/dev case).
+HOST_TIER_GUARD_BYTES = 4 << 30
+
+
+def table_bytes(vocab_size: int, dim: int, itemsize: int = 4) -> int:
+    """Padded lane-packed storage bytes for one table (excl. optimizer)."""
+    rows, width = table_shape(vocab_size, dim)
+    return rows * width * itemsize
+
+
+def exceeds_hbm_guard(vocab_size: int, dim: int, num_devices: int = 0) -> bool:
+    """True when the PER-DEVICE share of table + 2 Adam moments exceeds
+    HOST_TIER_GUARD_BYTES.  The table row-shards over the whole mesh, so a
+    table that crowds one chip can be fine on a pod — ``num_devices``
+    defaults to the current backend's device count (1 on a lone chip)."""
+    if num_devices <= 0:
+        num_devices = jax.device_count()
+    return 3 * table_bytes(vocab_size, dim) > HOST_TIER_GUARD_BYTES * num_devices
+
+
 #: Lookup implementations (ParallelContext.embedding_impl / config flag).
 IMPL_AUTO = "auto"
 IMPL_RAGGED = "ragged"

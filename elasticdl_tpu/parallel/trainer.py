@@ -26,7 +26,8 @@ are left alone.  The two paths are consistent without rescaling.
 from __future__ import annotations
 
 import inspect
-from typing import Any, Callable, Dict, List, Tuple
+import os
+from typing import Any, Callable, Dict, List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -161,6 +162,28 @@ class Trainer:
         self._train_step = None
         self._eval_step = None
         self._predict_step = None
+        # Host-tier tables (spec.host_io): rows live in the native C++ store
+        # on this host; the trainer pulls/injects per step and pushes the
+        # sparse cotangents back (models/spec.HostTableIO).
+        self._host_stores: Dict[str, Any] = {}
+        if spec.host_io:
+            procs = {d.process_index for d in mesh.devices.flat}
+            if len(procs) > 1:
+                raise NotImplementedError(
+                    "host-tier embedding tables need a per-job store service "
+                    "for multi-host meshes; single-process meshes only for now"
+                )
+            from elasticdl_tpu.ps.host_store import HostEmbeddingStore
+
+            self._host_stores = {
+                key: HostEmbeddingStore(
+                    dim=io.dim,
+                    optimizer=io.optimizer,
+                    learning_rate=io.learning_rate,
+                    init_scale=io.init_scale,
+                )
+                for key, io in spec.host_io.items()
+            }
 
     def _make_ctx(self) -> ParallelContext:
         # Resolve "auto" against the MESH's platform (not the default
@@ -275,12 +298,94 @@ class Trainer:
 
         return jax.tree.map(to_global, batch)
 
+    # ---- host-tier pull/push (spec.host_io) ----
+
+    def _inject_host_rows(self, batch: Any) -> Tuple[Any, Dict[str, Any]]:
+        ids = {k: io.ids_fn(batch) for k, io in self.spec.host_io.items()}
+        injected = dict(batch)
+        for key, table_ids in ids.items():
+            injected[key] = self._host_stores[key].pull(table_ids)
+        return injected, ids
+
+    def run_train_step(self, state: TrainState, batch: Any):
+        """Full training step from a HOST batch: host-tier pull -> shard ->
+        jitted step -> sparse cotangent push.  Without host tables this is
+        just shard+step."""
+        if not self.spec.host_io:
+            return self.train_step(state, self.shard_batch(batch))
+        injected, ids = self._inject_host_rows(batch)
+        state, metrics, host_grads = self.train_step(
+            state, self.shard_batch(injected)
+        )
+        for key, grads in host_grads.items():
+            # The store applies its server-side optimizer per distinct id,
+            # duplicates pre-accumulated (the reference PS's IndexedSlices
+            # apply, in C++ — ps/native/edl_native.cc).
+            self._host_stores[key].push_grad(ids[key], np.asarray(grads))
+        return state, metrics
+
+    def run_eval_step(self, state: TrainState, batch: Any):
+        if self.spec.host_io:
+            batch, _ = self._inject_host_rows(batch)
+        return self.eval_step(state, self.shard_batch(batch))
+
+    def run_predict_step(self, state: TrainState, batch: Any):
+        if self.spec.host_io:
+            batch, _ = self._inject_host_rows(batch)
+        return self.predict_step(state, self.shard_batch(batch))
+
+    def save_host_stores(self, directory: str, step: int, keep_max: int = 3) -> None:
+        """Snapshot host-tier stores alongside the Orbax checkpoint, pruning
+        old step snapshots like Orbax's own retention does (host tables are
+        the multi-GB case — unbounded snapshots would exhaust the volume)."""
+        if not self._host_stores:
+            return
+        root = os.path.join(directory, "host_stores")
+        d = os.path.join(root, str(step))
+        os.makedirs(d, exist_ok=True)
+        for key, store in self._host_stores.items():
+            store.save(os.path.join(d, f"{key}.bin"))
+        steps = sorted(
+            (int(s) for s in os.listdir(root) if s.isdigit()), reverse=True
+        )
+        for old in steps[max(keep_max, 1):]:
+            import shutil
+
+            shutil.rmtree(os.path.join(root, str(old)), ignore_errors=True)
+
+    def restore_host_stores(
+        self, directory: str, step: int, strict: bool = True
+    ) -> bool:
+        """Load host-tier snapshots for ``step``.  ``strict`` (default)
+        raises FileNotFoundError when the spec has host tables but the
+        snapshot is missing — silently continuing would pair restored dense
+        params with freshly re-initialized embeddings (a torn checkpoint)."""
+        if not self._host_stores:
+            return False
+        restored = False
+        for key, store in self._host_stores.items():
+            path = os.path.join(directory, "host_stores", str(step), f"{key}.bin")
+            if os.path.exists(path):
+                store.load(path)
+                restored = True
+            elif strict:
+                raise FileNotFoundError(
+                    f"host store snapshot missing for step {step}: {path} "
+                    "(torn checkpoint — dense state and host rows must "
+                    "restore together)"
+                )
+        return restored
+
     # ---- step builders ----
 
     def train_step(self, state: TrainState, batch: Any):
         if self._train_step is None:
             self._train_step = build_train_step(
-                self.spec, self.mesh, self.ctx, self.state_specs()
+                self.spec,
+                self.mesh,
+                self.ctx,
+                self.state_specs(),
+                host_keys=tuple(sorted(self.spec.host_io)),
             )
         return self._train_step(state, batch)
 
@@ -300,8 +405,17 @@ class Trainer:
 
 
 def build_train_step(
-    spec: ModelSpec, mesh: Mesh, ctx: ParallelContext, state_specs: TrainState
+    spec: ModelSpec,
+    mesh: Mesh,
+    ctx: ParallelContext,
+    state_specs: TrainState,
+    host_keys: Sequence[str] = (),
 ) -> Callable:
+    """The jitted train step.  With ``host_keys`` (host-tier tables), the
+    step ALSO differentiates with respect to those injected batch arrays and
+    returns their cotangents as a third output, batch-sharded — the
+    device-side half of the pull/step/push cycle (Trainer.run_train_step).
+    """
     axis = ctx.axis_name
     assert axis is not None
     # Paths of sharded-table grads (params-relative): these come out of the
@@ -311,12 +425,18 @@ def build_train_step(
 
     def local_step(state: TrainState, batch):
         n = lax.axis_size(axis)
+        batch = dict(batch)
+        host_in = {k: batch.pop(k) for k in host_keys}
 
-        def loss_fn(params):
-            out = spec.apply(params, batch, train=True, ctx=ctx)
-            return spec.loss(out, batch) / n, out
+        def loss_fn(params, host_embs):
+            merged = dict(batch)
+            merged.update(host_embs)
+            out = spec.apply(params, merged, train=True, ctx=ctx)
+            return spec.loss(out, merged) / n, out
 
-        (loss, out), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        (loss, out), (grads, host_grads) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1), has_aux=True
+        )(state.params, host_in)
         grads = _tree_psum_except(grads, grad_skip, axis)
         loss = lax.psum(loss, axis)
         updates, opt_state = spec.optimizer.update(grads, state.opt_state, state.params)
@@ -324,13 +444,20 @@ def build_train_step(
         metrics = {k: lax.pmean(v, axis) for k, v in spec.metrics(out, batch).items()}
         metrics["loss"] = loss
         new_state = TrainState(step=state.step + 1, params=params, opt_state=opt_state)
+        if host_keys:
+            # Per-example cotangents of the global-mean loss, batch-sharded;
+            # NOT psum'd (each example's grad lives on its own shard).
+            return new_state, metrics, host_grads
         return new_state, metrics
 
+    out_specs: Tuple = (state_specs, P())
+    if host_keys:
+        out_specs = (state_specs, P(), {k: P(axis) for k in host_keys})
     mapped = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(state_specs, P(axis)),
-        out_specs=(state_specs, P()),
+        out_specs=out_specs,
         check_vma=False,
     )
     return jax.jit(mapped, donate_argnums=(0,))

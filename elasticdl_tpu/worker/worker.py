@@ -231,6 +231,18 @@ class Worker:
             self._ckpt.wait()
             template = self.trainer.shard_state(jax.device_get(self.state))
             restored = self._ckpt.restore(template)
+            try:
+                self.trainer.restore_host_stores(
+                    self._ckpt.directory, int(restored.step)
+                )
+            except FileNotFoundError:
+                # In-process resize: the LIVE host stores survive in this
+                # trainer, so a missing snapshot is tolerable (slightly newer
+                # rows than the restored dense step) — log, don't die.
+                logger.warning(
+                    "no host-store snapshot for step %d; keeping live rows",
+                    int(restored.step),
+                )
             logger.info("restored checkpoint step %d", int(restored.step))
         if restored is None:
             restored = self.trainer.shard_state(jax.device_get(self.state))
@@ -272,6 +284,7 @@ class Worker:
                 )
         elif self._rank == 0:
             self._ckpt.save(step, jax.device_get(self.state))
+            self.trainer.save_host_stores(self._ckpt.directory, step)
             self._last_ckpt_step = step
             self.master.call(
                 "ReportCheckpoint",
@@ -304,9 +317,9 @@ class Worker:
         n_batches = 0
         for chunk, _ in _minibatches(records, self.config.minibatch_size, True):
             batch = self.spec.feed(chunk)
-            self.state, metrics = self.trainer.train_step(
-                self.state, self.trainer.shard_batch(batch)
-            )
+            # run_train_step = (host-tier pull ->) shard -> jitted step
+            # (-> sparse push); plain shard+step when no host tables.
+            self.state, metrics = self.trainer.run_train_step(self.state, batch)
             # Aggregate across the task's minibatches (equal sizes — tails
             # wrap-pad) instead of reporting only the last one's metrics.
             # Accumulate the DEVICE scalars: a float() here would block on
@@ -331,9 +344,7 @@ class Worker:
             batch["__mask__"] = (
                 np.arange(self.config.minibatch_size) < true_count
             ).astype(np.float32)
-            metrics = self.trainer.eval_step(
-                self.state, self.trainer.shard_batch(batch)
-            )
+            metrics = self.trainer.run_eval_step(self.state, batch)
             for k, v in metrics.items():
                 sums[k] = sums.get(k, 0.0) + float(v) * true_count
             total += true_count
@@ -346,9 +357,7 @@ class Worker:
             records, self.config.minibatch_size, False
         ):
             batch = self.spec.feed(chunk)
-            out = self.trainer.predict_step(
-                self.state, self.trainer.shard_batch(batch)
-            )
+            out = self.trainer.run_predict_step(self.state, batch)
             outs.append(np.asarray(out)[:true_count])
         if self.config.prediction_outputs:
             os.makedirs(self.config.prediction_outputs, exist_ok=True)
@@ -381,10 +390,18 @@ class Worker:
             ckpt_info = self.master.call("GetCheckpoint", {})
             if ckpt_info.get("path") and self._ckpt is not None:
                 try:
-                    self.state = self._ckpt.restore(self.state)
+                    # Commit ATOMICALLY: adopt the restored dense state only
+                    # if the matching host-store snapshot also loads (a torn
+                    # pair would silently train trained dense layers against
+                    # re-initialized embeddings).
+                    restored = self._ckpt.restore(self.state)
+                    self.trainer.restore_host_stores(
+                        self._ckpt.directory, int(restored.step)
+                    )
+                    self.state = restored
                     logger.info("joined from checkpoint step %d", int(self.state.step))
-                except FileNotFoundError:
-                    pass
+                except FileNotFoundError as e:
+                    logger.warning("checkpoint join skipped: %s", e)
 
         tasks_done = 0
         while True:
@@ -480,6 +497,7 @@ class Worker:
             step = int(self.state.step)
             payload = self.state if self._group_mode else jax.device_get(self.state)
             self._ckpt.save(step, payload, wait=True)
+            self.trainer.save_host_stores(self._ckpt.directory, step)
             if self._rank == 0:
                 self.master.call(
                     "ReportCheckpoint",

@@ -1,0 +1,215 @@
+"""Host-tier embedding integration (VERDICT r2 Missing #3 / task 4): tables
+too large for HBM live in the native C++ store (ps/native); the trainer
+pulls unique rows pre-step, injects them into the jitted step, and pushes
+the sparse cotangents post-step for the store's server-side optimizer.
+
+The store itself (numerics, checkpoint, optimizers) is covered by
+tests/test_host_store.py; these tests cover the TRAINING integration."""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.common.config import DistributionStrategy, JobConfig
+from elasticdl_tpu.models.spec import load_model_spec
+from elasticdl_tpu.parallel.mesh import create_mesh
+from elasticdl_tpu.parallel.trainer import Trainer
+
+pytestmark = pytest.mark.skipif(
+    not __import__(
+        "elasticdl_tpu.ps.host_store", fromlist=["native_lib_available"]
+    ).native_lib_available(),
+    reason="native host store unavailable (g++ build failed)",
+)
+
+
+def _host_spec(buckets=512, dim=4, hidden=(16,)):
+    return load_model_spec(
+        "elasticdl_tpu.models",
+        "deepfm.model_spec",
+        compute_dtype="float32",
+        buckets_per_feature=buckets,
+        embedding_dim=dim,
+        hidden=hidden,
+        host_tier=True,
+    )
+
+
+def _batch(rng, n=32):
+    return {
+        "dense": rng.uniform(0, 100, size=(n, 13)).astype(np.float32),
+        "cat": rng.integers(0, 1 << 30, size=(n, 26)).astype(np.int32),
+        "labels": rng.integers(0, 2, size=(n,)).astype(np.int32),
+    }
+
+
+def test_host_ids_match_device_hash():
+    """The host-side numpy id function must reproduce the on-device hash
+    bit-for-bit, or pulls would fetch the wrong rows."""
+    import jax
+
+    from elasticdl_tpu.models.tabular import (
+        fuse_feature_ids,
+        fuse_feature_ids_np,
+    )
+
+    cat = np.random.default_rng(0).integers(0, 1 << 30, size=(64, 26)).astype(np.int32)
+    dev = np.asarray(jax.jit(lambda c: fuse_feature_ids(c, 65536))(cat))
+    host = fuse_feature_ids_np(cat, 65536)
+    np.testing.assert_array_equal(host, dev)
+
+
+def test_auto_promotion_by_hbm_guard():
+    """buckets 2^24 -> 26 x 16.7M rows x stride 16: far past the HBM guard;
+    "auto" promotes the table to the host tier, so init allocates NO device
+    table and the spec carries host_io instead of embedding_tables."""
+    import jax
+
+    spec = load_model_spec(
+        "elasticdl_tpu.models",
+        "deepfm.model_spec",
+        compute_dtype="float32",
+        buckets_per_feature=1 << 24,
+        embedding_dim=8,
+        hidden=(16,),
+        host_tier="auto",
+    )
+    assert spec.host_io and not spec.embedding_tables
+    params = jax.eval_shape(spec.init, jax.random.key(0))
+    assert "fm_table" not in params  # no device allocation for 436M rows
+    # small vocab stays on the mesh
+    small = load_model_spec(
+        "elasticdl_tpu.models",
+        "deepfm.model_spec",
+        buckets_per_feature=512,
+        host_tier="auto",
+    )
+    assert small.embedding_tables and not small.host_io
+
+
+def test_guard_exceeding_table_trains(devices):
+    """The done-criterion: a DeepFM variant whose table exceeds the HBM
+    guard trains (loss falls), with rows materializing lazily in the C++
+    store — only the touched rows exist."""
+    import jax
+
+    spec = load_model_spec(
+        "elasticdl_tpu.models",
+        "deepfm.model_spec",
+        compute_dtype="float32",
+        buckets_per_feature=1 << 24,  # 436M logical rows: HBM-impossible
+        embedding_dim=8,
+        hidden=(16,),
+        host_tier="auto",
+    )
+    assert spec.host_io
+    trainer = Trainer(
+        spec,
+        JobConfig(distribution_strategy=DistributionStrategy.PARAMETER_SERVER),
+        create_mesh(devices),
+    )
+    state = trainer.init_state(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    batch = _batch(rng)
+    losses = []
+    for _ in range(8):
+        state, metrics = trainer.run_train_step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], losses
+    store = trainer._host_stores["__host__fm_table"]
+    # only the batch's distinct ids materialized, not 436M rows
+    n_ids = len(np.unique(np.asarray(
+        spec.host_io["__host__fm_table"].ids_fn(batch)
+    )))
+    assert len(store) == n_ids
+
+
+def test_host_tier_matches_device_tier_forward(devices):
+    """Freshly-initialized host rows produce the same MODEL STRUCTURE as the
+    device path: eval metrics finite, predictions shaped per-example."""
+    import jax
+
+    spec = _host_spec()
+    trainer = Trainer(
+        spec,
+        JobConfig(distribution_strategy=DistributionStrategy.PARAMETER_SERVER),
+        create_mesh(devices),
+    )
+    state = trainer.init_state(jax.random.key(0))
+    batch = _batch(np.random.default_rng(1))
+    metrics = trainer.run_eval_step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+    out = trainer.run_predict_step(state, batch)
+    assert np.asarray(out).shape == (32,)
+
+
+def test_host_store_checkpoint_roundtrip(tmp_path, devices):
+    """save_host_stores/restore_host_stores alongside Orbax: trained rows
+    survive into a fresh trainer."""
+    import jax
+
+    spec = _host_spec()
+    config = JobConfig(distribution_strategy=DistributionStrategy.PARAMETER_SERVER)
+    trainer = Trainer(spec, config, create_mesh(devices))
+    state = trainer.init_state(jax.random.key(0))
+    batch = _batch(np.random.default_rng(2))
+    for _ in range(3):
+        state, _ = trainer.run_train_step(state, batch)
+    key = "__host__fm_table"
+    ids = spec.host_io[key].ids_fn(batch)
+    before = trainer._host_stores[key].pull(ids)
+    trainer.save_host_stores(str(tmp_path), 3)
+
+    fresh = Trainer(_host_spec(), config, create_mesh(devices))
+    assert fresh.restore_host_stores(str(tmp_path), 3)
+    np.testing.assert_array_equal(fresh._host_stores[key].pull(ids), before)
+    # A missing snapshot is a torn checkpoint: strict mode (the restore
+    # path's default) fails loud, non-strict reports False.
+    with pytest.raises(FileNotFoundError, match="torn"):
+        fresh.restore_host_stores(str(tmp_path), 99)
+    assert not fresh.restore_host_stores(str(tmp_path), 99, strict=False)
+
+
+def test_host_store_snapshot_retention(tmp_path, devices):
+    """save_host_stores prunes old step dirs like Orbax retention does."""
+    import jax
+
+    spec = _host_spec()
+    config = JobConfig(distribution_strategy=DistributionStrategy.PARAMETER_SERVER)
+    trainer = Trainer(spec, config, create_mesh(devices))
+    state = trainer.init_state(jax.random.key(0))
+    state, _ = trainer.run_train_step(state, _batch(np.random.default_rng(3)))
+    for step in (1, 2, 3, 4, 5):
+        trainer.save_host_stores(str(tmp_path), step, keep_max=3)
+    import os
+
+    kept = sorted(os.listdir(tmp_path / "host_stores"))
+    assert kept == ["3", "4", "5"]
+
+
+def test_dispatcher_stop_is_sticky(tmp_path):
+    """After --max_steps stop(), failed/timed-out/recovered tasks must NOT
+    requeue — requeueing would re-open dispatch past the limit."""
+    from elasticdl_tpu.data.synthetic import generate
+    from elasticdl_tpu.data.reader import create_data_reader
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+
+    generate("mnist", str(tmp_path / "t.rio"), 64)
+    shards = create_data_reader(str(tmp_path / "t.rio")).create_shards(16)
+    clock = [0.0]
+    d = TaskDispatcher(shards, num_epochs=10, task_timeout_s=5.0,
+                       clock=lambda: clock[0])
+    t1 = d.get_task("w0")
+    t2 = d.get_task("w1")
+    d.stop()
+    assert d.counts()["todo"] == 0
+    # failure after stop: dropped, not requeued
+    d.report(t1.task_id, success=False)
+    assert d.counts()["todo"] == 0
+    # timeout after stop: released, not requeued
+    clock[0] = 100.0
+    assert d.get_task("w2") is None
+    # dead-worker recovery after stop: released, not requeued
+    d.recover_tasks("w1")
+    assert d.counts()["todo"] == 0
+    assert d.finished()
