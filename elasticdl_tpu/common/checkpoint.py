@@ -58,6 +58,11 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def all_steps(self) -> list:
+        """Retained checkpoint steps, newest first (torn-checkpoint fallback
+        walks these until one restores completely)."""
+        return sorted(self._mgr.all_steps(), reverse=True)
+
     def wait(self) -> None:
         self._mgr.wait_until_finished()
 

@@ -112,3 +112,23 @@ def census_feed(records: Sequence[bytes]) -> dict:
         "cat": hashing(cat_raw).astype(np.int32),
         "labels": labels,
     }
+
+
+# ---------------- language modeling (transformer_lm) ----------------
+
+
+def encode_lm_example(tokens: np.ndarray) -> bytes:
+    """One training sequence of S+1 int32 token ids (the +1 supplies the
+    next-token labels; the feed splits tokens[:-1] / tokens[1:], so the
+    label shift never crosses a sequence-parallel shard boundary)."""
+    return np.ascontiguousarray(tokens, np.int32).tobytes()
+
+
+def lm_feed(records: Sequence[bytes]) -> dict:
+    buf = np.frombuffer(b"".join(records), dtype=np.int32)
+    seq_plus_1 = len(records[0]) // 4
+    seqs = buf.reshape(len(records), seq_plus_1)
+    return {
+        "tokens": np.ascontiguousarray(seqs[:, :-1]),
+        "labels": np.ascontiguousarray(seqs[:, 1:]),
+    }

@@ -84,6 +84,12 @@ class ModelSpec:
     # the injected vectors from the batch under the key instead of looking
     # up a params table.
     host_io: Dict[str, "HostTableIO"] = dataclasses.field(default_factory=dict)
+    # Which batch dimension the mesh axis shards: 0 = data parallelism
+    # (examples, the default), 1 = sequence/context parallelism (each device
+    # holds every example's [S/n] chunk — ring attention territory).  Leaves
+    # with ndim <= batch_shard_dim (e.g. per-example masks under SP) are
+    # replicated.
+    batch_shard_dim: int = 0
     # Example batch (tiny) for compile checks / shape inference.
     example_batch: Optional[Callable[[int], Batch]] = None
 

@@ -1,0 +1,153 @@
+"""Decoder-only transformer LM with ring-attention sequence parallelism.
+
+Long-context is first-class in this rebuild (the reference predates it —
+SURVEY.md §2 parallelism table: SP/CP absent upstream; this is a TPU-native
+capability extension, not a parity item).  The model declares
+``batch_shard_dim=1``: the trainer shards the SEQUENCE dimension over the
+mesh axis, each device holds ``[B, S/n]`` of every sequence, and attention
+runs blockwise while K/V blocks rotate around the ICI ring
+(``ops/ring_attention.py`` — compute overlaps the ppermute transfer, so HBM
+per device scales with S/n, enabling sequences that cannot fit one chip).
+
+The label shift never crosses shard boundaries: the codec stores S+1 tokens
+per record and the feed emits (tokens[:-1], tokens[1:]) BEFORE sharding.
+Dense params are replicated with psum'd grads (the AllReduce strategy), so
+SP composes with the existing trainer unchanged; positions are globalized
+with the device's axis index.
+
+Architecture: pre-RMSNorm blocks, causal MHA (ring), GELU MLP (4x), learned
+positional embedding, weight-tied LM head.  bfloat16 compute, f32 params.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+from elasticdl_tpu.data.codecs import lm_feed
+from elasticdl_tpu.models.spec import ModelSpec
+from elasticdl_tpu.ops.ring_attention import ring_attention
+from elasticdl_tpu.ops.embedding import ParallelContext
+
+
+def _rms_norm(x, scale, eps=1e-6):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * scale
+
+
+def _init_params(
+    rng, vocab: int, dim: int, n_heads: int, n_layers: int, max_seq: int
+) -> Dict[str, Any]:
+    ks = iter(jax.random.split(rng, 3 + 5 * n_layers))
+    scale = dim**-0.5
+    params: Dict[str, Any] = {
+        "tok_emb": jax.random.normal(next(ks), (vocab, dim)) * scale,
+        "pos_emb": jax.random.normal(next(ks), (max_seq, dim)) * 0.01,
+        "ln_f": jnp.ones((dim,), jnp.float32),
+        "blocks": {},
+    }
+    for i in range(n_layers):
+        params["blocks"][f"b{i}"] = {
+            "ln1": jnp.ones((dim,), jnp.float32),
+            "wqkv": jax.random.normal(next(ks), (dim, 3 * dim)) * scale,
+            "wo": jax.random.normal(next(ks), (dim, dim)) * scale,
+            "ln2": jnp.ones((dim,), jnp.float32),
+            "w1": jax.random.normal(next(ks), (dim, 4 * dim)) * scale,
+            "w2": jax.random.normal(next(ks), (4 * dim, dim)) * (0.5 * scale),
+        }
+    return params
+
+
+def _apply(
+    params,
+    batch,
+    train: bool = False,
+    ctx: ParallelContext = ParallelContext(),
+    n_heads: int = 4,
+    compute_dtype=jnp.bfloat16,
+    **_,
+):
+    tokens = batch["tokens"]  # [B, L_local] (sequence-sharded over the axis)
+    b, l = tokens.shape
+    dim = params["tok_emb"].shape[-1]
+    head_dim = dim // n_heads
+    axis = ctx.axis_name
+    # Global positions of this device's sequence chunk.
+    offset = lax.axis_index(axis) * l if axis is not None else 0
+    pos = offset + jnp.arange(l)
+
+    x = params["tok_emb"][tokens] + params["pos_emb"][pos][None]
+    x = x.astype(compute_dtype)
+    for name in sorted(params["blocks"]):
+        blk = params["blocks"][name]
+        h = _rms_norm(x, blk["ln1"])
+        qkv = h @ blk["wqkv"].astype(compute_dtype)  # [B, L, 3*dim]
+        q, k, v = jnp.split(qkv.reshape(b, l, 3 * n_heads, head_dim), 3, axis=2)
+        # Blockwise causal attention; K/V ring over the sequence axis.
+        att = ring_attention(q, k, v, axis_name=axis, causal=True)
+        x = x + att.reshape(b, l, dim) @ blk["wo"].astype(compute_dtype)
+        h = _rms_norm(x, blk["ln2"])
+        h = jax.nn.gelu(h @ blk["w1"].astype(compute_dtype))
+        x = x + h @ blk["w2"].astype(compute_dtype)
+    x = _rms_norm(x, params["ln_f"])
+    # Weight-tied head; logits in f32 for a stable softmax/CE.
+    return (x @ params["tok_emb"].T.astype(compute_dtype)).astype(jnp.float32)
+
+
+def _loss(logits, batch):
+    # Mean CE over this device's tokens; the trainer's /n + psum makes it
+    # the global mean (equal chunk sizes by construction).
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits.reshape(-1, logits.shape[-1]), batch["labels"].reshape(-1)
+    ).mean()
+
+
+def _metrics(logits, batch):
+    ce = _loss(logits, batch)
+    acc = jnp.mean(
+        (jnp.argmax(logits, -1) == batch["labels"]).astype(jnp.float32)
+    )
+    return {"loss": ce, "accuracy": acc}
+
+
+def _example_batch(batch_size: int, seq_len: int = 256):
+    return {
+        "tokens": jnp.zeros((batch_size, seq_len), jnp.int32),
+        "labels": jnp.zeros((batch_size, seq_len), jnp.int32),
+    }
+
+
+def model_spec(
+    learning_rate: float = 3e-4,
+    compute_dtype: str = "bfloat16",
+    vocab: int = 8192,
+    dim: int = 256,
+    n_heads: int = 4,
+    n_layers: int = 2,
+    max_seq: int = 4096,
+    seq_len: int = 256,
+) -> ModelSpec:
+    dtype = jnp.dtype(compute_dtype)
+    return ModelSpec(
+        name="transformer_lm",
+        init=functools.partial(
+            _init_params,
+            vocab=vocab,
+            dim=dim,
+            n_heads=n_heads,
+            n_layers=n_layers,
+            max_seq=max_seq,
+        ),
+        apply=functools.partial(_apply, n_heads=n_heads, compute_dtype=dtype),
+        loss=_loss,
+        metrics=_metrics,
+        optimizer=optax.adamw(learning_rate),
+        feed=lm_feed,
+        example_batch=functools.partial(_example_batch, seq_len=seq_len),
+        batch_shard_dim=1,  # sequence parallelism (see module docstring)
+    )

@@ -167,6 +167,12 @@ class Trainer:
         # sparse cotangents back (models/spec.HostTableIO).
         self._host_stores: Dict[str, Any] = {}
         if spec.host_io:
+            if spec.batch_shard_dim != 0:
+                raise NotImplementedError(
+                    "host-tier tables assume data-parallel batches "
+                    "(batch_shard_dim=0); sequence-parallel models cannot "
+                    "route per-example host rows yet"
+                )
             procs = {d.process_index for d in mesh.devices.flat}
             if len(procs) > 1:
                 raise NotImplementedError(
@@ -262,41 +268,66 @@ class Trainer:
 
         return jax.tree.map(place, state, shardings)
 
+    def _batch_spec_for(self, leaf) -> P:
+        """PartitionSpec for one batch leaf: the mesh axis shards dimension
+        ``spec.batch_shard_dim`` (0 = examples, 1 = sequence); leaves too
+        small to have that dimension (per-example masks under SP) replicate."""
+        d = self.spec.batch_shard_dim
+        if d == 0:
+            return P(self.axis_name)
+        if getattr(leaf, "ndim", 0) > d:
+            return P(*([None] * d), self.axis_name)
+        return P()
+
+    def batch_specs(self, batch: Any):
+        return jax.tree.map(self._batch_spec_for, batch)
+
     def shard_batch(self, batch: Any) -> Any:
-        """Place a GLOBAL batch on the mesh, batch-dim sharded.
+        """Place a GLOBAL batch on the mesh, sharded on the model's
+        ``batch_shard_dim`` (examples for DP, sequence for SP).
 
         Single-process meshes device_put directly.  Multi-process meshes
         (jax.distributed worlds) cannot device_put onto non-addressable
         devices; every process feeds the same deterministic global batch and
-        contributes its own row range via
+        contributes its own slice via
         ``jax.make_array_from_process_local_data`` (SURVEY.md §3.5).
         """
         n = self.mesh.devices.size
-        leaves = jax.tree.leaves(batch)
-        if leaves and leaves[0].shape[0] % n != 0:
-            raise ValueError(
-                f"global batch {leaves[0].shape[0]} not divisible by mesh size {n}"
-            )
-        sharding = NamedSharding(self.mesh, P(self.axis_name))
-        procs = {d.process_index for d in self.mesh.devices.flat}
+        d = self.spec.batch_shard_dim
+        for leaf in jax.tree.leaves(batch):
+            if getattr(leaf, "ndim", 0) > d and leaf.shape[d] % n != 0:
+                raise ValueError(
+                    f"batch dimension {d} of size {leaf.shape[d]} not "
+                    f"divisible by mesh size {n}"
+                )
+        shardings = jax.tree.map(
+            lambda x: NamedSharding(self.mesh, self._batch_spec_for(x)), batch
+        )
+        procs = {d_.process_index for d_ in self.mesh.devices.flat}
         if len(procs) <= 1:
-            return jax.device_put(batch, sharding)
+            return jax.device_put(batch, shardings)
 
-        def to_global(x):
+        def to_global(x, sh):
             x = np.asarray(x)
-            # This process's contiguous row range under batch-dim sharding:
+            spec_dims = [i for i, s in enumerate(sh.spec) if s is not None]
+            if not spec_dims:  # replicated leaf: full copy from each process
+                return jax.make_array_from_process_local_data(sh, x, x.shape)
+            dd = spec_dims[0]
+            # This process's contiguous slice range along the sharded dim:
             # the union of its addressable devices' index slices.
-            idx_map = sharding.addressable_devices_indices_map(x.shape)
-            starts = [s[0].start or 0 for s in idx_map.values()]
+            idx_map = sh.addressable_devices_indices_map(x.shape)
+            starts = [s[dd].start or 0 for s in idx_map.values()]
             stops = [
-                x.shape[0] if s[0].stop is None else s[0].stop
+                x.shape[dd] if s[dd].stop is None else s[dd].stop
                 for s in idx_map.values()
             ]
+            take = [slice(None)] * x.ndim
+            take[dd] = slice(min(starts), max(stops))
             return jax.make_array_from_process_local_data(
-                sharding, x[min(starts):max(stops)], x.shape
+                sh, x[tuple(take)], x.shape
             )
 
-        return jax.tree.map(to_global, batch)
+        return jax.tree.map(to_global, batch, shardings)
 
     # ---- host-tier pull/push (spec.host_io) ----
 
@@ -344,7 +375,13 @@ class Trainer:
         d = os.path.join(root, str(step))
         os.makedirs(d, exist_ok=True)
         for key, store in self._host_stores.items():
-            store.save(os.path.join(d, f"{key}.bin"))
+            # Atomic per-file commit: a crash mid-write must leave either no
+            # snapshot (restore falls back to an older step) or a complete
+            # one — never a truncated file that poisons every relaunch.
+            final = os.path.join(d, f"{key}.bin")
+            tmp = final + ".tmp"
+            store.save(tmp)
+            os.replace(tmp, final)
         steps = sorted(
             (int(s) for s in os.listdir(root) if s.isdigit()), reverse=True
         )
@@ -366,9 +403,20 @@ class Trainer:
         for key, store in self._host_stores.items():
             path = os.path.join(directory, "host_stores", str(step), f"{key}.bin")
             if os.path.exists(path):
-                store.load(path)
-                restored = True
-            elif strict:
+                try:
+                    store.load(path)
+                except (IOError, ValueError) as e:
+                    if strict:
+                        # Surface as torn-checkpoint so callers' fallback
+                        # (try an older step) applies uniformly.
+                        raise FileNotFoundError(
+                            f"host store snapshot for step {step} is "
+                            f"unreadable ({e}): {path}"
+                        ) from e
+                else:
+                    restored = True
+                    continue
+            if strict:
                 raise FileNotFoundError(
                     f"host store snapshot missing for step {step}: {path} "
                     "(torn checkpoint — dense state and host rows must "
@@ -386,20 +434,29 @@ class Trainer:
                 self.ctx,
                 self.state_specs(),
                 host_keys=tuple(sorted(self.spec.host_io)),
+                batch_specs=self.batch_specs(batch),
             )
         return self._train_step(state, batch)
 
     def eval_step(self, state: TrainState, batch: Any) -> Dict[str, jax.Array]:
         if self._eval_step is None:
             self._eval_step = build_eval_step(
-                self.spec, self.mesh, self.ctx, self.state_specs()
+                self.spec,
+                self.mesh,
+                self.ctx,
+                self.state_specs(),
+                batch_specs=self.batch_specs(batch),
             )
         return self._eval_step(state, batch)
 
     def predict_step(self, state: TrainState, batch: Any):
         if self._predict_step is None:
             self._predict_step = build_predict_step(
-                self.spec, self.mesh, self.ctx, self.state_specs()
+                self.spec,
+                self.mesh,
+                self.ctx,
+                self.state_specs(),
+                batch_specs=self.batch_specs(batch),
             )
         return self._predict_step(state, batch)
 
@@ -410,6 +467,7 @@ def build_train_step(
     ctx: ParallelContext,
     state_specs: TrainState,
     host_keys: Sequence[str] = (),
+    batch_specs: Any = None,
 ) -> Callable:
     """The jitted train step.  With ``host_keys`` (host-tier tables), the
     step ALSO differentiates with respect to those injected batch arrays and
@@ -456,7 +514,7 @@ def build_train_step(
     mapped = shard_map(
         local_step,
         mesh=mesh,
-        in_specs=(state_specs, P(axis)),
+        in_specs=(state_specs, batch_specs if batch_specs is not None else P(axis)),
         out_specs=out_specs,
         check_vma=False,
     )
@@ -464,7 +522,11 @@ def build_train_step(
 
 
 def build_predict_step(
-    spec: ModelSpec, mesh: Mesh, ctx: ParallelContext, state_specs: TrainState
+    spec: ModelSpec,
+    mesh: Mesh,
+    ctx: ParallelContext,
+    state_specs: TrainState,
+    batch_specs: Any = None,
 ) -> Callable:
     """Per-example model outputs, batch-sharded in and out (the reference's
     predict mode, SURVEY.md §2 #1 'predict')."""
@@ -474,18 +536,25 @@ def build_predict_step(
     def local_predict(state: TrainState, batch):
         return spec.apply(state.params, batch, train=False, ctx=ctx)
 
+    d = spec.batch_shard_dim
     mapped = shard_map(
         local_predict,
         mesh=mesh,
-        in_specs=(state_specs, P(axis)),
-        out_specs=P(axis),
+        in_specs=(state_specs, batch_specs if batch_specs is not None else P(axis)),
+        # Per-example outputs shard on the model's batch dimension (the
+        # sequence dim for SP models).
+        out_specs=P(*([None] * d), axis),
         check_vma=False,
     )
     return jax.jit(mapped)
 
 
 def build_eval_step(
-    spec: ModelSpec, mesh: Mesh, ctx: ParallelContext, state_specs: TrainState
+    spec: ModelSpec,
+    mesh: Mesh,
+    ctx: ParallelContext,
+    state_specs: TrainState,
+    batch_specs: Any = None,
 ) -> Callable:
     axis = ctx.axis_name
     assert axis is not None
@@ -514,7 +583,7 @@ def build_eval_step(
     mapped = shard_map(
         local_eval,
         mesh=mesh,
-        in_specs=(state_specs, P(axis)),
+        in_specs=(state_specs, batch_specs if batch_specs is not None else P(axis)),
         out_specs=P(),
         check_vma=False,
     )

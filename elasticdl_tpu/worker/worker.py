@@ -389,19 +389,24 @@ class Worker:
             # Elastic re-join: adopt the job's latest snapshot if one exists.
             ckpt_info = self.master.call("GetCheckpoint", {})
             if ckpt_info.get("path") and self._ckpt is not None:
-                try:
-                    # Commit ATOMICALLY: adopt the restored dense state only
-                    # if the matching host-store snapshot also loads (a torn
-                    # pair would silently train trained dense layers against
-                    # re-initialized embeddings).
-                    restored = self._ckpt.restore(self.state)
-                    self.trainer.restore_host_stores(
-                        self._ckpt.directory, int(restored.step)
-                    )
-                    self.state = restored
-                    logger.info("joined from checkpoint step %d", int(self.state.step))
-                except FileNotFoundError as e:
-                    logger.warning("checkpoint join skipped: %s", e)
+                # Walk retained steps newest-first; adopt a step only when
+                # BOTH halves restore (a torn pair — dense committed but the
+                # host snapshot missing/truncated after a crash — would
+                # silently pair trained dense layers with re-initialized
+                # embeddings).  An older intact step beats starting over.
+                for step in self._ckpt.all_steps():
+                    try:
+                        restored = self._ckpt.restore(self.state, step=step)
+                        self.trainer.restore_host_stores(
+                            self._ckpt.directory, step
+                        )
+                        self.state = restored
+                        logger.info("joined from checkpoint step %d", step)
+                        break
+                    except FileNotFoundError as e:
+                        logger.warning(
+                            "checkpoint step %d torn (%s); trying older", step, e
+                        )
 
         tasks_done = 0
         while True:

@@ -198,3 +198,31 @@ def test_training_metrics_averaged(tmp_path, devices):
         np.testing.assert_allclose(
             got[k], (seen[0][k] + seen[1][k]) / 2, rtol=1e-6
         )
+
+
+def test_dispatcher_stop_is_sticky(tmp_path):
+    """After --max_steps stop(), failed/timed-out/recovered tasks must NOT
+    requeue — requeueing would re-open dispatch past the limit."""
+    from elasticdl_tpu.data.synthetic import generate
+    from elasticdl_tpu.data.reader import create_data_reader
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+
+    generate("mnist", str(tmp_path / "t.rio"), 64)
+    shards = create_data_reader(str(tmp_path / "t.rio")).create_shards(16)
+    clock = [0.0]
+    d = TaskDispatcher(shards, num_epochs=10, task_timeout_s=5.0,
+                       clock=lambda: clock[0])
+    t1 = d.get_task("w0")
+    t2 = d.get_task("w1")
+    d.stop()
+    assert d.counts()["todo"] == 0
+    # failure after stop: dropped, not requeued
+    d.report(t1.task_id, success=False)
+    assert d.counts()["todo"] == 0
+    # timeout after stop: released, not requeued
+    clock[0] = 100.0
+    assert d.get_task("w2") is None
+    # dead-worker recovery after stop: released, not requeued
+    d.recover_tasks("w1")
+    assert d.counts()["todo"] == 0
+    assert d.finished()

@@ -187,29 +187,41 @@ def test_host_store_snapshot_retention(tmp_path, devices):
     assert kept == ["3", "4", "5"]
 
 
-def test_dispatcher_stop_is_sticky(tmp_path):
-    """After --max_steps stop(), failed/timed-out/recovered tasks must NOT
-    requeue — requeueing would re-open dispatch past the limit."""
-    from elasticdl_tpu.data.synthetic import generate
-    from elasticdl_tpu.data.reader import create_data_reader
-    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+def test_torn_checkpoint_falls_back_to_older_step(tmp_path, devices):
+    """A crash can commit the Orbax half of step N without its host-store
+    snapshot.  The worker's join walks retained steps newest-first and
+    adopts the newest INTACT pair instead of crashing or starting over."""
+    import shutil
 
-    generate("mnist", str(tmp_path / "t.rio"), 64)
-    shards = create_data_reader(str(tmp_path / "t.rio")).create_shards(16)
-    clock = [0.0]
-    d = TaskDispatcher(shards, num_epochs=10, task_timeout_s=5.0,
-                       clock=lambda: clock[0])
-    t1 = d.get_task("w0")
-    t2 = d.get_task("w1")
-    d.stop()
-    assert d.counts()["todo"] == 0
-    # failure after stop: dropped, not requeued
-    d.report(t1.task_id, success=False)
-    assert d.counts()["todo"] == 0
-    # timeout after stop: released, not requeued
-    clock[0] = 100.0
-    assert d.get_task("w2") is None
-    # dead-worker recovery after stop: released, not requeued
-    d.recover_tasks("w1")
-    assert d.counts()["todo"] == 0
-    assert d.finished()
+    import jax
+
+    from elasticdl_tpu.common.checkpoint import CheckpointManager
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.worker.worker import DirectMasterProxy, Worker
+
+    spec = _host_spec()
+    config = JobConfig(
+        distribution_strategy=DistributionStrategy.PARAMETER_SERVER,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+    )
+    trainer = Trainer(spec, config, create_mesh(devices))
+    state = trainer.init_state(jax.random.key(0))
+    batch = _batch(np.random.default_rng(4))
+    ckpt = CheckpointManager(str(tmp_path / "ckpt"))
+    for step in (1, 2):
+        state, _ = trainer.run_train_step(state, batch)
+        ckpt.save(step, jax.device_get(state), wait=True)
+        trainer.save_host_stores(ckpt.directory, step)
+    ckpt.close()
+    # Tear step 2: orbax half exists, host half gone (crash mid-write).
+    shutil.rmtree(tmp_path / "ckpt" / "host_stores" / "2")
+
+    servicer = MasterServicer(TaskDispatcher([]))  # no tasks: join then exit
+    servicer.ReportCheckpoint({"path": str(tmp_path / "ckpt"), "step": 2})
+    worker = Worker(
+        config, DirectMasterProxy(servicer),
+        reader=None, worker_id="w0", spec=_host_spec(), devices=devices,
+    )
+    result = worker.run()
+    assert result["step"] == 1  # fell back to the intact step, not 2, not 0

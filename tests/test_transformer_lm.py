@@ -1,0 +1,115 @@
+"""Sequence-parallel transformer LM: the ring-attention op integrated into a
+trainable model family (previously the op was test-only).  The mesh axis
+shards the SEQUENCE dim (ModelSpec.batch_shard_dim=1); parity is asserted
+against the identical model run unsharded."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elasticdl_tpu.common.config import JobConfig
+from elasticdl_tpu.models.spec import load_model_spec
+from elasticdl_tpu.parallel.mesh import create_mesh
+from elasticdl_tpu.parallel.trainer import Trainer
+
+SEQ = 64
+VOCAB = 512
+
+
+def _spec(**kw):
+    return load_model_spec(
+        "elasticdl_tpu.models",
+        "transformer_lm.model_spec",
+        compute_dtype="float32",
+        vocab=VOCAB,
+        dim=64,
+        n_heads=4,
+        n_layers=2,
+        max_seq=SEQ,
+        seq_len=SEQ,
+        **kw,
+    )
+
+
+def _batch(rng, b=4):
+    toks = rng.integers(0, VOCAB, size=(b, SEQ + 1)).astype(np.int32)
+    return {
+        "tokens": toks[:, :-1],
+        "labels": toks[:, 1:],
+    }
+
+
+def test_sequence_parallel_matches_unsharded(devices):
+    """Forward loss and one train step over an 8-way sequence-sharded mesh
+    equal the 1-device run bit-for-bit-ish (fp tolerance): ring attention +
+    global positions + psum'd grads reproduce full attention."""
+    spec8, spec1 = _spec(), _spec()
+    rng = np.random.default_rng(0)
+    batch = _batch(rng)
+    cfg = JobConfig(distribution_strategy="AllReduce")
+
+    tr8 = Trainer(spec8, cfg, create_mesh(devices, num_devices=8))
+    tr1 = Trainer(spec1, cfg, create_mesh(devices, num_devices=1))
+    s8 = tr8.init_state(jax.random.key(0))
+    s1 = tr1.init_state(jax.random.key(0))
+
+    s8, m8 = tr8.run_train_step(s8, batch)
+    s1, m1 = tr1.run_train_step(s1, batch)
+    np.testing.assert_allclose(float(m8["loss"]), float(m1["loss"]), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(m8["accuracy"]), float(m1["accuracy"]), rtol=1e-6
+    )
+    # params after the update agree too (grads were identical)
+    p8 = jax.device_get(s8).params
+    p1 = jax.device_get(s1).params
+    for k8, k1 in zip(jax.tree.leaves(p8), jax.tree.leaves(p1)):
+        np.testing.assert_allclose(np.asarray(k8), np.asarray(k1),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_lm_learns_planted_rule(devices, tmp_path):
+    """End-to-end: synthetic LM data with a planted next-token rule; training
+    over the sequence-sharded mesh drives loss far below uniform."""
+    from elasticdl_tpu.data.reader import create_data_reader
+    from elasticdl_tpu.data.synthetic import generate
+
+    path = str(tmp_path / "lm.rio")
+    generate("lm", path, 64, seq_len=SEQ, vocab=VOCAB)
+    reader = create_data_reader(path)
+    records = list(reader.read_records(reader.create_shards(64)[0]))
+    spec = _spec(learning_rate=3e-3)
+    batch = spec.feed(records)
+
+    tr = Trainer(spec, JobConfig(distribution_strategy="AllReduce"),
+                 create_mesh(devices))
+    state = tr.init_state(jax.random.key(0))
+    losses = []
+    for _ in range(80):
+        state, metrics = tr.run_train_step(state, batch)
+        losses.append(float(metrics["loss"]))
+    uniform = float(np.log(VOCAB))
+    assert losses[0] > uniform * 0.8  # starts near uniform
+    assert losses[-1] < uniform * 0.5, losses[-5:]  # learned the rule
+
+
+def test_lm_eval_and_predict_shapes(devices):
+    spec = _spec()
+    tr = Trainer(spec, JobConfig(distribution_strategy="AllReduce"),
+                 create_mesh(devices))
+    state = tr.init_state(jax.random.key(0))
+    batch = _batch(np.random.default_rng(1))
+    metrics = tr.run_eval_step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+    out = np.asarray(tr.run_predict_step(state, batch))
+    assert out.shape == (4, SEQ, VOCAB)
+
+
+def test_seq_not_divisible_raises(devices):
+    spec = _spec()
+    tr = Trainer(spec, JobConfig(distribution_strategy="AllReduce"),
+                 create_mesh(devices))
+    bad = {"tokens": np.zeros((4, 60), np.int32),
+           "labels": np.zeros((4, 60), np.int32)}
+    with pytest.raises(ValueError, match="dimension 1"):
+        tr.shard_batch(bad)
