@@ -77,6 +77,14 @@ def _apply(
     dim = params["tok_emb"].shape[-1]
     head_dim = dim // n_heads
     axis = ctx.axis_name
+    # Fail loud on over-long sequences: positions past max_seq would silently
+    # CLAMP on the pos_emb gather (same stance as the embedding OOV contract).
+    n_shards = lax.axis_size(axis) if axis is not None else 1
+    if l * n_shards > params["pos_emb"].shape[0]:
+        raise ValueError(
+            f"global sequence length {l * n_shards} exceeds max_seq "
+            f"{params['pos_emb'].shape[0]}; raise max_seq in the model spec"
+        )
     # Global positions of this device's sequence chunk.
     offset = lax.axis_index(axis) * l if axis is not None else 0
     pos = offset + jnp.arange(l)

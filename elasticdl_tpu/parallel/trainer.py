@@ -399,30 +399,51 @@ class Trainer:
         params with freshly re-initialized embeddings (a torn checkpoint)."""
         if not self._host_stores:
             return False
-        restored = False
-        for key, store in self._host_stores.items():
-            path = os.path.join(directory, "host_stores", str(step), f"{key}.bin")
-            if os.path.exists(path):
-                try:
-                    store.load(path)
-                except (IOError, ValueError) as e:
-                    if strict:
-                        # Surface as torn-checkpoint so callers' fallback
-                        # (try an older step) applies uniformly.
-                        raise FileNotFoundError(
-                            f"host store snapshot for step {step} is "
-                            f"unreadable ({e}): {path}"
-                        ) from e
-                else:
-                    restored = True
-                    continue
+        paths = {
+            key: os.path.join(directory, "host_stores", str(step), f"{key}.bin")
+            for key in self._host_stores
+        }
+        missing = [p for p in paths.values() if not os.path.exists(p)]
+        if missing:
+            # Validate BEFORE mutating any store: a partial load would pair
+            # some tables' checkpoint rows with others' live/fresh rows.
             if strict:
                 raise FileNotFoundError(
-                    f"host store snapshot missing for step {step}: {path} "
-                    "(torn checkpoint — dense state and host rows must "
-                    "restore together)"
+                    f"host store snapshot missing for step {step}: "
+                    f"{missing[0]} (torn checkpoint — dense state and host "
+                    "rows must restore together)"
                 )
-        return restored
+            # non-strict: load whatever exists (in-process resize keeps live
+            # rows for the rest)
+            loaded = False
+            for key, path in paths.items():
+                if os.path.exists(path):
+                    self._host_stores[key].load(path)
+                    loaded = True
+            return loaded
+        try:
+            for key, path in paths.items():
+                self._host_stores[key].load(path)
+        except (IOError, ValueError) as e:
+            # A corrupt file detected mid-load leaves earlier stores mutated;
+            # re-initialize them all so a fallback to an older step (or a
+            # fresh start) never mixes rows from a torn step.
+            from elasticdl_tpu.ps.host_store import HostEmbeddingStore
+
+            self._host_stores = {
+                key: HostEmbeddingStore(
+                    dim=io.dim,
+                    optimizer=io.optimizer,
+                    learning_rate=io.learning_rate,
+                    init_scale=io.init_scale,
+                )
+                for key, io in self.spec.host_io.items()
+            }
+            raise FileNotFoundError(
+                f"host store snapshot for step {step} is unreadable ({e}); "
+                "stores re-initialized"
+            ) from e
+        return True
 
     # ---- step builders ----
 

@@ -394,7 +394,8 @@ class Worker:
                 # host snapshot missing/truncated after a crash — would
                 # silently pair trained dense layers with re-initialized
                 # embeddings).  An older intact step beats starting over.
-                for step in self._ckpt.all_steps():
+                steps = self._ckpt.all_steps()
+                for step in steps:
                     try:
                         restored = self._ckpt.restore(self.state, step=step)
                         self.trainer.restore_host_stores(
@@ -406,6 +407,12 @@ class Worker:
                     except FileNotFoundError as e:
                         logger.warning(
                             "checkpoint step %d torn (%s); trying older", step, e
+                        )
+                else:
+                    if steps:
+                        logger.error(
+                            "every retained checkpoint step %s was torn; "
+                            "training from freshly initialized state", steps,
                         )
 
         tasks_done = 0

@@ -18,9 +18,7 @@ VOCAB = 512
 
 
 def _spec(**kw):
-    return load_model_spec(
-        "elasticdl_tpu.models",
-        "transformer_lm.model_spec",
+    params = dict(
         compute_dtype="float32",
         vocab=VOCAB,
         dim=64,
@@ -28,7 +26,10 @@ def _spec(**kw):
         n_layers=2,
         max_seq=SEQ,
         seq_len=SEQ,
-        **kw,
+    )
+    params.update(kw)
+    return load_model_spec(
+        "elasticdl_tpu.models", "transformer_lm.model_spec", **params
     )
 
 
@@ -113,3 +114,14 @@ def test_seq_not_divisible_raises(devices):
            "labels": np.zeros((4, 60), np.int32)}
     with pytest.raises(ValueError, match="dimension 1"):
         tr.shard_batch(bad)
+
+
+def test_over_long_sequence_fails_loud(devices):
+    """Positions past max_seq must raise, not silently clamp on the pos_emb
+    gather (the repo's fail-loud stance)."""
+    spec = _spec(max_seq=32)  # < SEQ=64
+    tr = Trainer(spec, JobConfig(distribution_strategy="AllReduce"),
+                 create_mesh(devices))
+    state = tr.init_state(jax.random.key(0))
+    with pytest.raises(ValueError, match="max_seq"):
+        tr.run_train_step(state, _batch(np.random.default_rng(0)))

@@ -1,0 +1,77 @@
+"""Smoke-test the REAL ``lax.ragged_all_to_all`` HLO on live TPU hardware.
+
+CI tests run on XLA:CPU, which lacks this HLO, so they exercise the
+identical routing code through the ``ragged_emulated`` collective; the bench
+takes the dense short-circuit at n=1.  This script is the hardware proof:
+an n=1 TPU mesh with an EXPLICIT ``impl="ragged"`` (honored for exactly this
+purpose) runs the op forward AND backward (custom_vjp) and checks numerics
+against a plain gather.
+
+Last verified: 2026-07-30 on v5e ("REAL ragged_all_to_all HLO: fwd+bwd
+(custom_vjp) executed on TPU, numerics match").
+
+Usage: python tools/ragged_smoke.py   (needs the TPU; do not run concurrently
+with other chip users)
+"""
+
+from elasticdl_tpu.common.platform import apply_platform_env
+
+apply_platform_env()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import NamedSharding  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from elasticdl_tpu.ops.embedding import (  # noqa: E402
+    ParallelContext,
+    embedding_lookup,
+    pack_table,
+)
+from elasticdl_tpu.parallel.mesh import create_mesh  # noqa: E402
+
+
+def main() -> None:
+    devices = jax.devices()
+    assert devices[0].platform == "tpu", f"needs TPU, got {devices}"
+    mesh = create_mesh(devices)
+    axis = mesh.axis_names[0]
+    table = jax.random.normal(jax.random.key(0), (256, 16), jnp.float32)
+    packed = pack_table(table, 16)
+    ids = jax.random.randint(jax.random.key(1), (64,), 0, 256)
+    cot = jax.random.normal(jax.random.key(2), (64, 16))
+    ctx = ParallelContext(
+        axis_name=axis, sharded_embeddings=True, embedding_impl="ragged"
+    )
+
+    def fwd_bwd(t, i, c):
+        def loss(tt):
+            return jnp.sum(embedding_lookup(tt, i, ctx, dim=16) * c)
+
+        return jax.value_and_grad(loss)(t)
+
+    mapped = jax.shard_map(
+        fwd_bwd,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis)),
+        out_specs=(P(), P(axis)),
+        check_vma=False,
+    )
+    sh = lambda a: jax.device_put(a, NamedSharding(mesh, P(axis)))  # noqa: E731
+    val, grad = jax.jit(mapped)(sh(packed), sh(ids), sh(cot))
+
+    exp_val = float(jnp.sum(jnp.take(table, ids, axis=0) * cot))
+    exp_grad = jax.grad(lambda t: jnp.sum(jnp.take(t, ids, axis=0) * cot))(table)
+    np.testing.assert_allclose(float(val), exp_val, rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(grad).reshape(-1, 16)[:256], np.asarray(exp_grad), rtol=1e-5
+    )
+    print(
+        "REAL ragged_all_to_all HLO: fwd+bwd (custom_vjp) executed on TPU, "
+        "numerics match"
+    )
+
+
+if __name__ == "__main__":
+    main()
