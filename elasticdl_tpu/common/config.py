@@ -70,11 +70,21 @@ class JobConfig:
     learning_rate: float = 1e-3
 
     # --- cluster shape ---
-    # (The reference's --num_ps_shards / --use_tpu flags are intentionally
-    # absent: the HBM-mesh design shards embeddings over the whole mesh by
-    # construction, and the platform comes from the environment/driver —
-    # neither flag could change behavior here, and dead flags lie.)
+    # (The reference's --use_tpu flag is intentionally absent: the platform
+    # comes from the environment/driver, so the flag could not change
+    # behavior here, and dead flags lie.)
     num_workers: int = 1
+    # PS pods for the HOST tier (ps/service.py): 0 = host-tier tables live in
+    # an in-process store on the (single) worker host; n > 0 = the master
+    # launches n PS service pods and every table partitions by id mod n
+    # across them — required for host-tier tables on multi-process meshes.
+    # Mesh-sharded (HBM) tables never use PS pods; they shard over the whole
+    # mesh by construction (ops/embedding.py).
+    num_ps_pods: int = 0
+    # host:port list of the PS shards, comma-separated, in shard order.  Set
+    # by the master onto the worker pod env; settable by hand to point
+    # workers at an externally managed PS fleet.
+    ps_addresses: str = ""
     # How the master launches workers: "process" (local subprocesses),
     # "kubernetes" (GKE TPU pods), or "fake" (tests).  The reference's
     # equivalent choice is implicit in running on k8s at all.
@@ -139,6 +149,8 @@ class JobConfig:
                 f"--pod_backend must be process|kubernetes|fake, got "
                 f"{self.pod_backend!r}"
             )
+        if self.num_ps_pods < 0:
+            raise ValueError("--num_ps_pods cannot be negative")
         # Kept in sync with ops.embedding.LOOKUP_IMPLS (asserted by tests);
         # not imported from there so this module stays jax-free (the master
         # control plane and pod manager must run without jax).

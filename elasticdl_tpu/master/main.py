@@ -45,6 +45,24 @@ from elasticdl_tpu.master.task_dispatcher import (
 logger = get_logger("master.main")
 
 
+def _pick_free_ports(n: int) -> List[int]:
+    """``n`` distinct currently-free localhost ports (bind-0 then release).
+    Racy by nature — another process could grab one before the PS pod binds —
+    but PS launch retries (PodManager relaunch policy) absorb the loss."""
+    import socket
+
+    socks = []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.bind(("", 0))
+            socks.append(s)
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
 class Master:
     """One training/evaluation/prediction job, master side."""
 
@@ -54,6 +72,7 @@ class Master:
         pod_backend: Optional[PodBackend] = None,
         port: int = 0,
         heartbeat_timeout_s: float = 30.0,
+        ps_backend: Optional[PodBackend] = None,
     ):
         config.validate()
         self.config = config
@@ -116,6 +135,51 @@ class Master:
         # Workers learn the master address through the config bus.
         config.master_addr = self.server.address
 
+        # -- PS fleet (host-tier service shards, ps/service.py) --
+        # Launched BEFORE workers so config.ps_addresses is on the worker
+        # config bus; fixed size (id-mod-n table partition — resharding a
+        # live fleet would remap every row's owner), pods relaunch on
+        # failure and restore their slice from the newest snapshot at
+        # startup (ps/main.py).  The reference's PS pods are likewise a
+        # fixed, master-created fleet (SURVEY.md §2 #10 [U]).
+        # The fleet runs for EVERY job type: evaluation/prediction over a
+        # PS-trained checkpoint needs the shards serving their restored
+        # slices (snapshots are per-shard files only the PS tier reads) —
+        # without them the trainer would fall back to fresh local stores
+        # and score re-initialized embeddings.
+        self.ps_manager: Optional[PodManager] = None
+        if config.num_ps_pods > 0:
+            ps_env: Dict[str, str] = {}
+            if config.pod_backend == "kubernetes":
+                # Cross-pod DNS needs a governing headless service named
+                # "<job>-ps" (documented deploy requirement); every shard
+                # serves the fixed PS port.  Addresses use the pod's STABLE
+                # per-slot hostname (render_ps_pod_manifest pins
+                # spec.hostname to the slot, so relaunched shards keep
+                # answering here) in the resolvable
+                # <hostname>.<subdomain>.<ns>.svc form.
+                port = 2222
+                ps_env["ELASTICDL_PS_PORTS"] = ",".join(
+                    str(port) for _ in range(config.num_ps_pods)
+                )
+                hosts = [
+                    f"{config.job_name}-ps-{i}.{config.job_name}-ps."
+                    f"{config.namespace}.svc:{port}"
+                    for i in range(config.num_ps_pods)
+                ]
+            else:
+                ports = _pick_free_ports(config.num_ps_pods)
+                ps_env["ELASTICDL_PS_PORTS"] = ",".join(map(str, ports))
+                hosts = [f"localhost:{p}" for p in ports]
+            config.ps_addresses = ",".join(hosts)
+            self.ps_manager = PodManager(
+                ps_backend if ps_backend is not None
+                else self._build_ps_backend(config),
+                config,
+                worker_env=ps_env,
+                name_prefix=f"{config.job_name}-ps",
+            )
+
         # -- worker fleet --
         self.pod_manager = PodManager(
             pod_backend if pod_backend is not None else self._build_backend(config),
@@ -135,6 +199,44 @@ class Master:
 
             return os.environ.get("MY_POD_IP") or socket.getfqdn()
         return "localhost"
+
+    @staticmethod
+    def _build_ps_backend(config: JobConfig) -> PodBackend:
+        if config.pod_backend == "kubernetes":
+            from elasticdl_tpu.master.pod_manager import (
+                KubernetesPodBackend,
+                render_ps_pod_manifest,
+            )
+
+            return KubernetesPodBackend(
+                config, namespace=config.namespace,
+                renderer=render_ps_pod_manifest, image=config.worker_image,
+            )
+        if config.pod_backend == "fake":
+            from elasticdl_tpu.master.pod_manager import FakePodBackend
+
+            return FakePodBackend()
+        return ProcessPodBackend(
+            argv=[sys.executable, "-m", "elasticdl_tpu.ps.main"]
+        )
+
+    def _wait_ps_ready(self, timeout_s: float = 60.0) -> None:
+        """Block until every PS shard's channel is ready — workers launched
+        against an unreachable PS fleet would crash-loop their relaunch
+        budgets away."""
+        if self.ps_manager is None or self.config.pod_backend == "fake":
+            return
+        import grpc
+
+        for addr in self.config.ps_addresses.split(","):
+            try:
+                channel = grpc.insecure_channel(addr)
+                grpc.channel_ready_future(channel).result(timeout=timeout_s)
+                channel.close()
+            except grpc.FutureTimeoutError:
+                raise RuntimeError(
+                    f"PS shard at {addr} not reachable after {timeout_s:.0f}s"
+                )
 
     @staticmethod
     def _build_backend(config: JobConfig) -> PodBackend:
@@ -162,9 +264,16 @@ class Master:
     def run(self, poll_interval_s: float = 0.2, reap_every_s: float = 5.0) -> Dict:
         """Supervise the job to completion; returns the final job status."""
         self.server.start()
-        self.pod_manager.start()
         last_reap = time.monotonic()
         try:
+            if self.ps_manager is not None:
+                # PS shards come up BEFORE workers dial them (launch order
+                # is the readiness story the reference gets from k8s init
+                # ordering).  Inside the try: a readiness timeout must still
+                # tear down the pods already launched.
+                self.ps_manager.start(self.config.num_ps_pods)
+                self._wait_ps_ready()
+            self.pod_manager.start()
             while not self.servicer.job_finished():
                 now = time.monotonic()
                 if now - last_reap >= reap_every_s:
@@ -199,6 +308,10 @@ class Master:
 
     def shutdown(self) -> None:
         self.pod_manager.stop()
+        if self.ps_manager is not None:
+            # After workers: their final checkpoint fans a Save out to the
+            # PS shards, which must still be serving.
+            self.ps_manager.stop()
         self.server.stop()
         if self.metrics_writer is not None:
             self.metrics_writer.close()

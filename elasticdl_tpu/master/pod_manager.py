@@ -297,14 +297,52 @@ def render_worker_pod_manifest(
     return manifest
 
 
+def render_ps_pod_manifest(
+    config: JobConfig,
+    pod_name: str,
+    env: Dict[str, str],
+    image: str = "elasticdl-tpu:latest",
+) -> dict:
+    """A V1Pod dict for one PS shard (ps/main.py): CPU-only — no TPU
+    resources or node selectors — with the shard's memory dominated by its
+    host-tier table slice.  Cross-pod reachability relies on a headless
+    service named ``<job>-ps`` governing these pods (master/main.py renders
+    shard addresses as ``<pod>.<job>-ps.<namespace>:2222``)."""
+    manifest = render_base_pod_manifest(
+        config.job_name,
+        pod_name,
+        "ps",
+        image,
+        ["python", "-m", "elasticdl_tpu.ps.main"],
+        env,
+    )
+    # Per-pod DNS under the headless service needs BOTH hostname and
+    # subdomain on the pod spec.  The hostname is derived from the SHARD
+    # slot, not the pod name: a relaunched shard gets a fresh pod name
+    # (slot-gen suffix, PodManager._new_pod_locked) but must keep answering
+    # at the address the master advertised to workers at job start.
+    slot = env.get("ELASTICDL_WORKER_SLOT", "0")
+    manifest["spec"]["hostname"] = f"{config.job_name}-ps-{slot}"
+    manifest["spec"]["subdomain"] = f"{config.job_name}-ps"
+    return manifest
+
+
 class KubernetesPodBackend(PodBackend):
     """Drives rendered manifests through the kubernetes python client.
 
     Import-gated: constructing it without the ``kubernetes`` package raises —
-    the manifest renderer above stays testable anywhere.
+    the manifest renderer above stays testable anywhere.  ``renderer`` picks
+    the manifest shape (worker TPU pods by default; ``render_ps_pod_manifest``
+    for PS shards).
     """
 
-    def __init__(self, config: JobConfig, namespace: str = "default", **render_kwargs):
+    def __init__(
+        self,
+        config: JobConfig,
+        namespace: str = "default",
+        renderer: Callable[..., dict] = render_worker_pod_manifest,
+        **render_kwargs,
+    ):
         try:
             import kubernetes  # type: ignore
         except ImportError as e:  # pragma: no cover - not installed in image
@@ -316,6 +354,7 @@ class KubernetesPodBackend(PodBackend):
         self._core = kubernetes.client.CoreV1Api()
         self._ns = namespace
         self._config = config
+        self._renderer = renderer
         self._render_kwargs = render_kwargs
         self._stop = threading.Event()
         self._watcher = threading.Thread(
@@ -324,7 +363,7 @@ class KubernetesPodBackend(PodBackend):
         self._watcher.start()
 
     def start_pod(self, name: str, env: Dict[str, str]) -> None:  # pragma: no cover
-        manifest = render_worker_pod_manifest(
+        manifest = self._renderer(
             self._config, name, env, **self._render_kwargs
         )
         self._core.create_namespaced_pod(self._ns, manifest)

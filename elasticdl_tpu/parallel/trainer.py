@@ -59,6 +59,11 @@ class TrainState(struct.PyTreeNode):
     opt_state: Any
 
 
+def _process_count(mesh: Mesh) -> int:
+    """Distinct host processes owning this mesh's devices (1 = single-host)."""
+    return len({d.process_index for d in mesh.devices.flat})
+
+
 def _path_keys(path) -> Tuple[str, ...]:
     keys = []
     for entry in path:
@@ -163,9 +168,12 @@ class Trainer:
         self._eval_step = None
         self._predict_step = None
         # Host-tier tables (spec.host_io): rows live in the native C++ store
-        # on this host; the trainer pulls/injects per step and pushes the
-        # sparse cotangents back (models/spec.HostTableIO).
+        # — in-process on this host (single-process meshes), or behind the
+        # gRPC PS service tier when the job runs PS pods (config.ps_addresses
+        # — ps/service.py).  The trainer pulls/injects per step and pushes
+        # the sparse cotangents back (models/spec.HostTableIO).
         self._host_stores: Dict[str, Any] = {}
+        self._remote_ps = False
         if spec.host_io:
             if spec.batch_shard_dim != 0:
                 raise NotImplementedError(
@@ -173,23 +181,40 @@ class Trainer:
                     "(batch_shard_dim=0); sequence-parallel models cannot "
                     "route per-example host rows yet"
                 )
-            procs = {d.process_index for d in mesh.devices.flat}
-            if len(procs) > 1:
-                raise NotImplementedError(
-                    "host-tier embedding tables need a per-job store service "
-                    "for multi-host meshes; single-process meshes only for now"
-                )
-            from elasticdl_tpu.ps.host_store import HostEmbeddingStore
+            addrs = [
+                a.strip()
+                for a in getattr(config, "ps_addresses", "").split(",")
+                if a.strip()
+            ]
+            if addrs:
+                # Shared PS service fleet: the only legal host-tier layout
+                # for multi-process meshes (a per-process store would train
+                # divergent row copies), and async-PS semantics throughout.
+                from elasticdl_tpu.ps.service import RemoteEmbeddingStore
 
-            self._host_stores = {
-                key: HostEmbeddingStore(
-                    dim=io.dim,
-                    optimizer=io.optimizer,
-                    learning_rate=io.learning_rate,
-                    init_scale=io.init_scale,
+                self._remote_ps = True
+                self._host_stores = {
+                    key: RemoteEmbeddingStore(key, io.dim, addrs)
+                    for key, io in spec.host_io.items()
+                }
+            elif _process_count(mesh) > 1:
+                raise NotImplementedError(
+                    "host-tier embedding tables on a multi-process mesh need "
+                    "the PS service tier: run with --num_ps_pods > 0 (or set "
+                    "--ps_addresses to an external PS fleet)"
                 )
-                for key, io in spec.host_io.items()
-            }
+            else:
+                from elasticdl_tpu.ps.host_store import HostEmbeddingStore
+
+                self._host_stores = {
+                    key: HostEmbeddingStore(
+                        dim=io.dim,
+                        optimizer=io.optimizer,
+                        learning_rate=io.learning_rate,
+                        init_scale=io.init_scale,
+                    )
+                    for key, io in spec.host_io.items()
+                }
 
     def _make_ctx(self) -> ParallelContext:
         # Resolve "auto" against the MESH's platform (not the default
@@ -331,11 +356,42 @@ class Trainer:
 
     # ---- host-tier pull/push (spec.host_io) ----
 
+    def _is_multiprocess(self) -> bool:
+        return _process_count(self.mesh) > 1
+
+    def _local_example_range(self, n_examples: int) -> Tuple[int, int]:
+        """This process's contiguous [lo, hi) slice of the batch dimension
+        under the data-parallel sharding (union of its addressable devices'
+        index slices)."""
+        sh = NamedSharding(self.mesh, P(self.axis_name))
+        idx_map = sh.addressable_devices_indices_map((n_examples,))
+        starts = [s[0].start or 0 for s in idx_map.values()]
+        stops = [
+            n_examples if s[0].stop is None else s[0].stop
+            for s in idx_map.values()
+        ]
+        return min(starts), max(stops)
+
     def _inject_host_rows(self, batch: Any) -> Tuple[Any, Dict[str, Any]]:
         ids = {k: io.ids_fn(batch) for k, io in self.spec.host_io.items()}
         injected = dict(batch)
+        multi = self._is_multiprocess()
         for key, table_ids in ids.items():
-            injected[key] = self._host_stores[key].pull(table_ids)
+            if multi:
+                # Pull only this process's example slice from the PS fleet;
+                # shard_batch's make_array_from_process_local_data reads
+                # exactly that slice of the global-shaped buffer, so the
+                # zero rows elsewhere are never consumed.
+                table_ids = np.asarray(table_ids)
+                lo, hi = self._local_example_range(table_ids.shape[0])
+                local = self._host_stores[key].pull(table_ids[lo:hi])
+                buf = np.zeros(
+                    (table_ids.shape[0],) + local.shape[1:], np.float32
+                )
+                buf[lo:hi] = local
+                injected[key] = buf
+            else:
+                injected[key] = self._host_stores[key].pull(table_ids)
         return injected, ids
 
     def run_train_step(self, state: TrainState, batch: Any):
@@ -348,11 +404,28 @@ class Trainer:
         state, metrics, host_grads = self.train_step(
             state, self.shard_batch(injected)
         )
+        multi = self._is_multiprocess()
         for key, grads in host_grads.items():
             # The store applies its server-side optimizer per distinct id,
             # duplicates pre-accumulated (the reference PS's IndexedSlices
-            # apply, in C++ — ps/native/edl_native.cc).
-            self._host_stores[key].push_grad(ids[key], np.asarray(grads))
+            # apply, in C++ — ps/native/edl_native.cc).  Multi-process
+            # worlds: each process pushes its OWN example slice (the only
+            # shards it can address); duplicates are pre-accumulated within
+            # a process's push but land as separate optimizer applies when
+            # the same id appears on two processes — the reference's
+            # per-worker async push has exactly these semantics.
+            if multi:
+                id_arr = np.asarray(ids[key])
+                part_ids = []
+                part_grads = []
+                for shard in grads.addressable_shards:
+                    part_ids.append(id_arr[shard.index[0]])
+                    part_grads.append(np.asarray(shard.data))
+                self._host_stores[key].push_grad(
+                    np.concatenate(part_ids), np.concatenate(part_grads)
+                )
+            else:
+                self._host_stores[key].push_grad(ids[key], np.asarray(grads))
         return state, metrics
 
     def run_eval_step(self, state: TrainState, batch: Any):
@@ -370,6 +443,19 @@ class Trainer:
         old step snapshots like Orbax's own retention does (host tables are
         the multi-GB case — unbounded snapshots would exhaust the volume)."""
         if not self._host_stores:
+            return
+        if self._remote_ps:
+            # PS fleet: each shard dumps its own slice atomically and prunes
+            # its own old files (ps/service.PSServer._save) — the worker only
+            # fans the request out.  ONE fan-out total: a Save request makes
+            # a shard snapshot EVERY table it serves, so looping over stores
+            # (all views of the same fleet) would rewrite identical files
+            # len(host_io) times per checkpoint.  Callers rank-gate this in
+            # multi-process worlds (worker._maybe_checkpoint) so shards save
+            # once per step.
+            next(iter(self._host_stores.values())).save_snapshot(
+                directory, step, keep_max=keep_max
+            )
             return
         root = os.path.join(directory, "host_stores")
         d = os.path.join(root, str(step))
@@ -399,6 +485,15 @@ class Trainer:
         params with freshly re-initialized embeddings (a torn checkpoint)."""
         if not self._host_stores:
             return False
+        if self._remote_ps:
+            # Async-PS semantics: the PS fleet outlives worker restarts, so
+            # an elastic re-join does NOT roll the host tier back to the
+            # checkpoint step (pushed gradients are never un-applied — the
+            # reference PS behaves identically).  PS pods restore their own
+            # slices from the newest complete snapshot when THEY (re)start
+            # (ps/main.py); the worker-side restore is therefore a no-op
+            # that reports the tier as intact.
+            return True
         paths = {
             key: os.path.join(directory, "host_stores", str(step), f"{key}.bin")
             for key in self._host_stores

@@ -1,0 +1,568 @@
+"""Parameter-server service tier: the native host store behind gRPC.
+
+Reference parity (SURVEY.md §2 #10, §3.4 [U — mount empty at survey time;
+existence of a gRPC parameter server is [D]: BASELINE.json names "gRPC
+parameter server" / pull_embedding_vectors / push_gradients): the reference
+runs dedicated PS pods — a gRPC service over a KV embedding store that
+applies gradients server-side — which every worker dials.  Here the same
+tier is ``PSServer``: a gRPC wrapper around the native C++
+``HostEmbeddingStore`` (ps/native/edl_native.cc), launched as PS pods by the
+master when ``--num_ps_pods > 0``, serving ``Pull`` / ``PushGrad`` /
+``Save`` / ``Load`` / ``Stats``.
+
+This tier exists for tables too large for the device mesh (the normal
+ParameterServer strategy shards tables over HBM — ops/embedding.py — which
+beats any RPC hop; see models/spec.HostTableIO).  Putting the host tier
+behind gRPC is what makes host-tier tables work on MULTI-PROCESS meshes: the
+store must be one shared service, not a per-worker-process sidecar, or each
+process would train a divergent copy of the rows.
+
+Sharding: ``--num_ps_pods = n`` partitions every table by ``id mod n`` (the
+reference partitions its embedding KV the same way across PS pods [U]).
+Row init is deterministic per id (splitmix64 in the native store), so the
+row a fresh id materializes as is identical no matter which shard serves it
+or how many shards exist.
+
+Wire format: tensors ride as raw little-endian buffers after a JSON header
+(``encode_frame``/``decode_frame``) — NOT JSON-encoded floats; a Pull of
+8192x26 dim-8 rows is ~6.8 MB of f32, which JSON would inflate ~4x and
+dominate the RPC cost.  The frame schema is validated at both ends like the
+master's MASTER_SCHEMAS contract (common/rpc.py).
+
+Failure/durability model (async-PS semantics, as the reference's):
+
+- PS pods outlive worker restarts: an elastic worker re-join does NOT roll
+  the host tier back to the checkpoint step (workers' dense params restore
+  to step S while PS rows stay live).  The reference's PS behaves the same
+  way — pushed gradients are never un-applied.
+- ``Save`` makes each shard dump its own slice atomically
+  (``{key}.shard{i}of{n}.bin``), mirroring "PS shards each dump their
+  slice" (SURVEY.md §5 checkpoint row); the worker that hits a checkpoint
+  step fans the Save out to every shard.
+- A relaunched PS pod restores its slice from the newest complete snapshot
+  at startup (``ps/main.py``); rows pushed after that snapshot are lost —
+  exactly the reference's PS-pod-crash semantics.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+from concurrent import futures
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import grpc
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("ps.service")
+
+PS_SERVICE_NAME = "elasticdl.PS"
+
+#: Methods -> (required meta fields -> types).  Arrays are declared
+#: separately per method; unknown meta fields pass through (forward compat).
+PS_METHODS: Dict[str, Dict[str, tuple]] = {
+    "Pull": {"table": (str,)},
+    "PushGrad": {"table": (str,)},
+    "Save": {"directory": (str,), "step": (int,)},
+    "Load": {"directory": (str,), "step": (int,), "strict": (bool,)},
+    "Stats": {},
+}
+
+_HEADER = struct.Struct("<I")  # u32 header length prefix
+
+
+class PSFrameError(ValueError):
+    """A frame violated the PS wire contract (boundary error, never a
+    KeyError deep in a handler — same principle as common/rpc.MessageSchema)."""
+
+
+def encode_frame(meta: Dict[str, Any], arrays: Dict[str, np.ndarray]) -> bytes:
+    """``u32 header_len | header JSON | concatenated raw buffers``.
+
+    The header carries ``meta`` plus each array's name/dtype/shape in payload
+    order; buffers are C-contiguous little-endian.
+    """
+    descs = []
+    bufs = []
+    for name, arr in arrays.items():
+        arr = np.ascontiguousarray(arr)
+        if arr.dtype.byteorder == ">":  # big-endian never happens on our
+            arr = arr.astype(arr.dtype.newbyteorder("<"))  # targets, but be exact
+        descs.append(
+            {"name": name, "dtype": arr.dtype.str, "shape": list(arr.shape)}
+        )
+        bufs.append(arr.tobytes())
+    header = json.dumps({"meta": meta, "arrays": descs}).encode()
+    return _HEADER.pack(len(header)) + header + b"".join(bufs)
+
+
+def decode_frame(payload: bytes) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
+    if len(payload) < _HEADER.size:
+        raise PSFrameError(f"frame too short ({len(payload)} bytes)")
+    (hlen,) = _HEADER.unpack_from(payload)
+    if _HEADER.size + hlen > len(payload):
+        raise PSFrameError("frame header runs past the payload")
+    try:
+        header = json.loads(payload[_HEADER.size : _HEADER.size + hlen].decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise PSFrameError(f"malformed frame header: {e}") from e
+    if not isinstance(header, dict) or "meta" not in header or "arrays" not in header:
+        raise PSFrameError("frame header must carry 'meta' and 'arrays'")
+    arrays: Dict[str, np.ndarray] = {}
+    off = _HEADER.size + hlen
+    for desc in header["arrays"]:
+        try:
+            dtype = np.dtype(desc["dtype"])
+            shape = tuple(int(d) for d in desc["shape"])
+            name = desc["name"]
+        except (KeyError, TypeError, ValueError) as e:
+            raise PSFrameError(f"malformed array descriptor {desc!r}") from e
+        nbytes = dtype.itemsize * int(np.prod(shape, dtype=np.int64))
+        if off + nbytes > len(payload):
+            raise PSFrameError(
+                f"array {name!r} ({nbytes} bytes) runs past the frame"
+            )
+        arrays[name] = np.frombuffer(
+            payload[off : off + nbytes], dtype=dtype
+        ).reshape(shape)
+        off += nbytes
+    return header["meta"], arrays
+
+
+def validate_meta(method: str, meta: Dict[str, Any]) -> None:
+    spec = PS_METHODS.get(method)
+    if spec is None:
+        raise PSFrameError(f"unknown PS method {method!r}")
+    problems = []
+    for field, types in spec.items():
+        if field not in meta:
+            problems.append(f"missing required field {field!r}")
+        elif not isinstance(meta[field], types) or (
+            isinstance(meta[field], bool) and bool not in types
+        ):
+            problems.append(
+                f"field {field!r} must be "
+                f"{'/'.join(t.__name__ for t in types)}, "
+                f"got {type(meta[field]).__name__}"
+            )
+    if problems:
+        raise PSFrameError(f"{method}: " + "; ".join(problems))
+
+
+def shard_of(ids: np.ndarray, num_shards: int) -> np.ndarray:
+    """Owning shard per id: ``id mod n``, non-negative for any int64 id."""
+    return (ids % num_shards + num_shards) % num_shards
+
+
+def snapshot_filename(key: str, shard: int, num_shards: int) -> str:
+    return f"{key}.shard{shard}of{num_shards}.bin"
+
+
+class PSServer:
+    """One PS shard: gRPC service over per-table native stores.
+
+    ``table_specs`` maps table key -> HostTableIO-like objects carrying
+    ``dim`` / ``optimizer`` / ``learning_rate`` / ``init_scale`` (usually a
+    ModelSpec's ``host_io``).  Tables materialize rows lazily on first pull,
+    so a shard's memory is proportional to the ids it has actually served.
+    """
+
+    def __init__(
+        self,
+        table_specs: Dict[str, Any],
+        shard: int = 0,
+        num_shards: int = 1,
+        port: int = 0,
+        max_workers: int = 16,
+    ):
+        from elasticdl_tpu.ps.host_store import HostEmbeddingStore
+
+        if not 0 <= shard < num_shards:
+            raise ValueError(f"shard {shard} out of range for {num_shards}")
+        self.shard = shard
+        self.num_shards = num_shards
+        self._stores = {
+            key: HostEmbeddingStore(
+                dim=io.dim,
+                optimizer=io.optimizer,
+                learning_rate=io.learning_rate,
+                init_scale=io.init_scale,
+            )
+            for key, io in table_specs.items()
+        }
+        self._lock = threading.Lock()  # serialize save/load vs pull/push
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers))
+        self._server.add_generic_rpc_handlers((self._make_handler(),))
+        self.port = self._server.add_insecure_port(f"[::]:{port}")
+        # grpc reports a lost bind as port 0.  Fail LOUDLY when a specific
+        # port was requested: the master advertised that port to workers, so
+        # a silently re-bound (or unbound) shard would serve nothing while
+        # looking healthy — crashing instead lets the pod relaunch policy
+        # retry the bind (the race window is a just-released probe port,
+        # master/main._pick_free_ports).
+        if self.port == 0 or (port and self.port != port):
+            raise RuntimeError(
+                f"PS shard {shard} failed to bind port {port} "
+                f"(got {self.port})"
+            )
+
+    # -- handlers --
+
+    def _store_for(self, meta: Dict[str, Any]):
+        store = self._stores.get(meta["table"])
+        if store is None:
+            raise PSFrameError(
+                f"unknown table {meta['table']!r}; this shard serves "
+                f"{sorted(self._stores)}"
+            )
+        return store
+
+    def _require(self, arrays: Dict[str, np.ndarray], name: str, dtype) -> np.ndarray:
+        if name not in arrays:
+            raise PSFrameError(f"missing array {name!r}")
+        arr = arrays[name]
+        if arr.dtype != np.dtype(dtype):
+            raise PSFrameError(
+                f"array {name!r} must be {np.dtype(dtype).str}, got {arr.dtype.str}"
+            )
+        return arr
+
+    def _pull(self, meta, arrays):
+        store = self._store_for(meta)
+        ids = self._require(arrays, "ids", np.int64)
+        with self._lock:
+            rows = store.pull(ids)
+        return {}, {"rows": rows}
+
+    def _push_grad(self, meta, arrays):
+        store = self._store_for(meta)
+        ids = self._require(arrays, "ids", np.int64)
+        grads = self._require(arrays, "grads", np.float32)
+        if grads.shape != ids.shape + (store.dim,):
+            raise PSFrameError(
+                f"grads shape {grads.shape} != ids {ids.shape} + (dim "
+                f"{store.dim},)"
+            )
+        with self._lock:
+            store.push_grad(ids, grads)
+        return {"applied": int(ids.size)}, {}
+
+    def _save(self, meta, arrays):
+        d = os.path.join(meta["directory"], "host_stores", str(meta["step"]))
+        os.makedirs(d, exist_ok=True)
+        rows = {}
+        with self._lock:
+            for key, store in self._stores.items():
+                final = os.path.join(
+                    d, snapshot_filename(key, self.shard, self.num_shards)
+                )
+                tmp = final + f".tmp{os.getpid()}"
+                rows[key] = store.save(tmp)
+                os.replace(tmp, final)  # atomic: no torn snapshot files
+        keep = int(meta.get("keep_max", 3))
+        self._prune(os.path.join(meta["directory"], "host_stores"), keep)
+        return {"rows": {k: int(v) for k, v in rows.items()}}, {}
+
+    def _prune(self, root: str, keep_max: int) -> None:
+        """Drop this shard's files from old step dirs; remove emptied dirs.
+        Each shard prunes only its own files so concurrent shards never race
+        on each other's snapshots."""
+        try:
+            steps = sorted((int(s) for s in os.listdir(root) if s.isdigit()),
+                           reverse=True)
+        except FileNotFoundError:
+            return
+        for old in steps[max(keep_max, 1):]:
+            d = os.path.join(root, str(old))
+            for key in self._stores:
+                try:
+                    os.remove(os.path.join(
+                        d, snapshot_filename(key, self.shard, self.num_shards)
+                    ))
+                except FileNotFoundError:
+                    pass
+            try:
+                os.rmdir(d)  # only succeeds once every shard has pruned
+            except OSError:
+                pass
+
+    def _load(self, meta, arrays):
+        d = os.path.join(meta["directory"], "host_stores", str(meta["step"]))
+        paths = {
+            key: os.path.join(
+                d, snapshot_filename(key, self.shard, self.num_shards)
+            )
+            for key in self._stores
+        }
+        missing = [p for p in paths.values() if not os.path.exists(p)]
+        if missing:
+            if meta["strict"]:
+                raise PSFrameError(
+                    f"snapshot missing for step {meta['step']}: {missing[0]}"
+                )
+            return {"loaded": False}, {}
+        with self._lock:
+            for key, path in paths.items():
+                self._stores[key].load(path)
+        return {"loaded": True}, {}
+
+    def _stats(self, meta, arrays):
+        return {
+            "shard": self.shard,
+            "num_shards": self.num_shards,
+            "tables": {k: len(s) for k, s in self._stores.items()},
+        }, {}
+
+    # -- plumbing --
+
+    def _make_handler(self) -> grpc.GenericRpcHandler:
+        methods = {
+            "Pull": self._pull,
+            "PushGrad": self._push_grad,
+            "Save": self._save,
+            "Load": self._load,
+            "Stats": self._stats,
+        }
+
+        def wrap(name, fn):
+            def handler(req: bytes, ctx):
+                try:
+                    meta, arrays = decode_frame(req)
+                    validate_meta(name, meta)
+                    out_meta, out_arrays = fn(meta, arrays)
+                except PSFrameError as e:
+                    ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+                except (IOError, ValueError) as e:
+                    ctx.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+                return encode_frame(out_meta, out_arrays)
+
+            return handler
+
+        handlers = {
+            name: grpc.unary_unary_rpc_method_handler(
+                wrap(name, fn),
+                request_deserializer=lambda b: b,
+                response_serializer=lambda b: b,
+            )
+            for name, fn in methods.items()
+        }
+        return grpc.method_handlers_generic_handler(PS_SERVICE_NAME, handlers)
+
+    @property
+    def address(self) -> str:
+        return f"localhost:{self.port}"
+
+    def start(self) -> "PSServer":
+        self._server.start()
+        logger.info(
+            "PS shard %d/%d serving %s on port %d",
+            self.shard, self.num_shards, sorted(self._stores), self.port,
+        )
+        return self
+
+    def wait(self) -> None:
+        self._server.wait_for_termination()
+
+    def stop(self, grace: float = 1.0) -> None:
+        self._server.stop(grace)
+
+    def restore_latest(self, checkpoint_dir: str) -> Optional[int]:
+        """Startup restore for a (re)launched PS pod: load this shard's slice
+        from the NEWEST step dir that has all of this shard's files; return
+        the step, or None when no complete snapshot exists (fresh stores).
+        Steps with missing/corrupt files for this shard are skipped — an
+        older complete snapshot beats a torn newer one."""
+        root = os.path.join(checkpoint_dir, "host_stores")
+        try:
+            steps = sorted((int(s) for s in os.listdir(root) if s.isdigit()),
+                           reverse=True)
+        except FileNotFoundError:
+            return None
+        for step in steps:
+            try:
+                meta, _ = self._load(
+                    {"directory": checkpoint_dir, "step": step, "strict": True},
+                    {},
+                )
+                logger.info("restored PS shard %d from step %d", self.shard, step)
+                return step
+            except (PSFrameError, IOError, ValueError) as e:
+                logger.warning("snapshot step %d unusable: %s", step, e)
+        return None
+
+
+class PSClient:
+    """Channel + typed calls to ONE PS shard."""
+
+    def __init__(self, address: str):
+        self.address = address
+        self._channel = grpc.insecure_channel(
+            address,
+            options=[
+                ("grpc.max_send_message_length", 256 << 20),
+                ("grpc.max_receive_message_length", 256 << 20),
+            ],
+        )
+        self._stubs: Dict[str, Any] = {}
+
+    def wait_ready(self, timeout_s: float = 20.0) -> None:
+        grpc.channel_ready_future(self._channel).result(timeout=timeout_s)
+
+    def call(
+        self,
+        method: str,
+        meta: Dict[str, Any],
+        arrays: Optional[Dict[str, np.ndarray]] = None,
+        timeout_s: float = 60.0,
+    ) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
+        validate_meta(method, meta)
+        if method not in self._stubs:
+            self._stubs[method] = self._channel.unary_unary(
+                f"/{PS_SERVICE_NAME}/{method}",
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b,
+            )
+        payload = self._stubs[method](
+            encode_frame(meta, arrays or {}), timeout=timeout_s
+        )
+        return decode_frame(payload)
+
+    def call_async(self, method, meta, arrays=None, timeout_s: float = 60.0):
+        """Future-returning variant (parallel fan-out across shards)."""
+        validate_meta(method, meta)
+        if method not in self._stubs:
+            self._stubs[method] = self._channel.unary_unary(
+                f"/{PS_SERVICE_NAME}/{method}",
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b,
+            )
+        return self._stubs[method].future(
+            encode_frame(meta, arrays or {}), timeout=timeout_s
+        )
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+class RemoteEmbeddingStore:
+    """HostEmbeddingStore-compatible view of one table across PS shards.
+
+    ``pull``/``push_grad`` take/return the same numpy shapes as the local
+    store; ids route to shard ``id mod n`` and per-shard RPCs run in
+    parallel (gRPC futures).  The trainer swaps this in for the local store
+    when the job runs with PS pods (config.ps_addresses), which is what
+    legalizes host-tier tables on multi-process meshes.
+    """
+
+    def __init__(self, table: str, dim: int, addresses: Sequence[str]):
+        if not addresses:
+            raise ValueError("RemoteEmbeddingStore needs >= 1 PS address")
+        self.table = table
+        self.dim = dim
+        self._clients = [PSClient(a) for a in addresses]
+        self.num_shards = len(self._clients)
+
+    def wait_ready(self, timeout_s: float = 20.0) -> None:
+        for c in self._clients:
+            c.wait_ready(timeout_s)
+
+    def __len__(self) -> int:
+        total = 0
+        for c in self._clients:
+            meta, _ = c.call("Stats", {})
+            total += int(meta["tables"].get(self.table, 0))
+        return total
+
+    def _partition(self, flat_ids: np.ndarray):
+        owner = shard_of(flat_ids, self.num_shards)
+        parts = [np.nonzero(owner == s)[0] for s in range(self.num_shards)]
+        return parts
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        ids = np.ascontiguousarray(ids, np.int64)
+        flat = ids.ravel()
+        out = np.empty((flat.size, self.dim), np.float32)
+        if self.num_shards == 1:
+            _, arrays = self._clients[0].call(
+                "Pull", {"table": self.table}, {"ids": flat}
+            )
+            out[:] = arrays["rows"]
+            return out.reshape(ids.shape + (self.dim,))
+        parts = self._partition(flat)
+        futs = [
+            (idx, self._clients[s].call_async(
+                "Pull", {"table": self.table}, {"ids": flat[idx]}
+            ))
+            for s, idx in enumerate(parts)
+            if idx.size
+        ]
+        for idx, fut in futs:
+            _, arrays = decode_frame(fut.result())
+            out[idx] = arrays["rows"]
+        return out.reshape(ids.shape + (self.dim,))
+
+    def push_grad(self, ids: np.ndarray, grads: np.ndarray) -> None:
+        ids = np.ascontiguousarray(ids, np.int64).ravel()
+        grads = np.ascontiguousarray(grads, np.float32).reshape(
+            ids.size, self.dim
+        )
+        if self.num_shards == 1:
+            self._clients[0].call(
+                "PushGrad", {"table": self.table},
+                {"ids": ids, "grads": grads},
+            )
+            return
+        parts = self._partition(ids)
+        futs = [
+            self._clients[s].call_async(
+                "PushGrad", {"table": self.table},
+                {"ids": ids[idx], "grads": grads[idx]},
+            )
+            for s, idx in enumerate(parts)
+            if idx.size
+        ]
+        for fut in futs:
+            fut.result()
+
+    # -- checkpoint fan-out (each shard dumps/loads its own slice) --
+
+    def save_snapshot(self, directory: str, step: int, keep_max: int = 3) -> None:
+        futs = [
+            c.call_async(
+                "Save",
+                {"directory": directory, "step": int(step), "keep_max": keep_max},
+            )
+            for c in self._clients
+        ]
+        for fut in futs:
+            fut.result()
+
+    def load_snapshot(self, directory: str, step: int, strict: bool = True) -> bool:
+        loaded = []
+        for c in self._clients:
+            try:
+                meta, _ = c.call(
+                    "Load",
+                    {"directory": directory, "step": int(step), "strict": strict},
+                )
+                loaded.append(bool(meta.get("loaded", True)))
+            except grpc.RpcError as e:
+                if strict:
+                    raise FileNotFoundError(
+                        f"PS shard at {c.address} failed to load step {step}: "
+                        f"{e.details() if hasattr(e, 'details') else e}"
+                    ) from e
+                loaded.append(False)
+        return all(loaded) and bool(loaded)
+
+    def close(self) -> None:
+        for c in self._clients:
+            c.close()
+
+
+def parse_ps_addresses(spec: str) -> List[str]:
+    return [a.strip() for a in spec.split(",") if a.strip()]

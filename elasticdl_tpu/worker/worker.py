@@ -278,6 +278,12 @@ class Worker:
             self._ckpt.save(step, self.state)
             self._last_ckpt_step = step
             if self._rank == 0:
+                # Host-tier PS snapshot: ONE process fans the Save out to
+                # the PS shards (each dumps its own slice); rank-gating
+                # keeps shards from writing the same step twice.  Unlike
+                # Orbax this is plain RPC — not collective — so the gate
+                # cannot deadlock the group.
+                self.trainer.save_host_stores(self._ckpt.directory, step)
                 self.master.call(
                     "ReportCheckpoint",
                     {"path": self._ckpt.directory, "step": step},
@@ -509,7 +515,10 @@ class Worker:
             step = int(self.state.step)
             payload = self.state if self._group_mode else jax.device_get(self.state)
             self._ckpt.save(step, payload, wait=True)
-            self.trainer.save_host_stores(self._ckpt.directory, step)
+            if self._rank == 0:
+                # Rank-gated like _maybe_checkpoint: one Save fan-out per
+                # step (plain RPC, not collective — no deadlock risk).
+                self.trainer.save_host_stores(self._ckpt.directory, step)
             if self._rank == 0:
                 self.master.call(
                     "ReportCheckpoint",
