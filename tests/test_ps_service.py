@@ -386,6 +386,162 @@ def test_master_launches_ps_fleet_end_to_end(tmp_path):
         assert (latest / snapshot_filename(HOST_FM_KEY, s, 2)).exists()
 
 
+@needs_native
+@pytest.mark.slow
+def test_two_process_world_trains_against_ps_fleet(tmp_path):
+    """THE multi-process host-tier proof: two real worker processes form one
+    jax.distributed world (8-device mesh) and train a host-tier DeepFM
+    against a shared 2-shard PS fleet.  Exercises the per-process slice pull
+    (_local_example_range), the addressable-shards-only cotangent push, and
+    the rank-gated snapshot fan-out — none of which run outside a real
+    multi-process world."""
+    import signal
+    import subprocess
+    import sys as _sys
+    import threading
+    import time
+
+    from elasticdl_tpu.data.synthetic import generate
+    from elasticdl_tpu.master.rendezvous import RendezvousServer
+    from elasticdl_tpu.master.servicer import MasterServer, MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.data.reader import create_data_reader
+    from elasticdl_tpu.worker.worker import RESTART_EXIT_CODE
+
+    data = str(tmp_path / "criteo.rio")
+    generate("criteo", data, 128)
+    reader = create_data_reader(data)
+    shards = reader.create_shards(32)
+
+    dispatcher = TaskDispatcher(shards, num_epochs=2)
+    rendezvous = RendezvousServer(heartbeat_timeout_s=6.0)
+    servicer = MasterServicer(dispatcher, rendezvous=rendezvous)
+    server = MasterServer(servicer, port=0).start()
+    stop = threading.Event()
+
+    max_world = {"n": 0}
+
+    def reap():
+        while not stop.is_set():
+            rendezvous.reap_dead()
+            max_world["n"] = max(
+                max_world["n"], rendezvous.membership()["world_size"]
+            )
+            time.sleep(0.25)
+
+    threading.Thread(target=reap, daemon=True).start()
+
+    model_params = (
+        'buckets_per_feature=64;embedding_dim=8;hidden=[16];'
+        'host_tier=true;compute_dtype="float32"'
+    )
+    from elasticdl_tpu.models.spec import load_model_spec
+
+    spec = load_model_spec(
+        "elasticdl_tpu.models", "deepfm.model_spec",
+        buckets_per_feature=64, embedding_dim=8, hidden=(16,),
+        host_tier=True, compute_dtype="float32",
+    )
+    ps_servers = [
+        PSServer(spec.host_io, shard=s, num_shards=2).start() for s in range(2)
+    ]
+
+    import socket as _socket
+
+    coord = _socket.socket()
+    coord.bind(("", 0))
+    coord_port = coord.getsockname()[1]
+    coord.close()
+
+    config = JobConfig(
+        model_def="deepfm.model_spec",
+        model_params=model_params,
+        distribution_strategy=DistributionStrategy.PARAMETER_SERVER,
+        training_data=data,
+        minibatch_size=16,
+        master_addr=server.address,
+        multihost=True,
+        coordinator_port=coord_port,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        checkpoint_steps=4,
+        num_epochs=2,
+        ps_addresses=",".join(s.address for s in ps_servers),
+    )
+
+    def _spawn(worker_id):
+        env = dict(os.environ)
+        env.update(config.to_env())
+        env["ELASTICDL_WORKER_ID"] = worker_id
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        log = open(tmp_path / f"{worker_id}.log", "w")
+        return subprocess.Popen(
+            [_sys.executable, "-m", "elasticdl_tpu.worker.main"],
+            env=env, stdout=log, stderr=subprocess.STDOUT, cwd="/root/repo",
+        )
+
+    def _log_tail(w):
+        return open(tmp_path / f"{w}.log").read()[-3000:]
+
+    procs = {}
+    relaunches = {"n": 0}
+    try:
+        procs.update({w: _spawn(w) for w in ("w-a", "w-b")})
+        deadline = time.time() + 420
+        while time.time() < deadline:
+            if servicer.JobStatus({})["finished"]:
+                break
+            for w, p in list(procs.items()):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                if rc == 0:
+                    procs.pop(w)
+                    continue
+                fatal = (
+                    "JAX distributed service detected fatal errors"
+                    in _log_tail(w)
+                )
+                if rc == RESTART_EXIT_CODE or fatal:
+                    assert relaunches["n"] < 8, (
+                        f"{w} restart churn; log:\n" + _log_tail(w)
+                    )
+                    relaunches["n"] += 1
+                    procs[w] = _spawn(w)
+                else:
+                    pytest.fail(f"{w} exited rc={rc}; log:\n" + _log_tail(w))
+            time.sleep(0.5)
+        status = servicer.JobStatus({})
+        assert status["finished"], (
+            f"job did not finish: {status}; logs:\n"
+            + "".join(_log_tail(w) for w in ("w-a", "w-b"))
+        )
+        # The proof is only multi-process if the world really reached 2.
+        assert max_world["n"] == 2, f"world never formed (max {max_world})"
+        # Both shards served pulls and took pushes: rows materialized.
+        sizes = []
+        for s in ps_servers:
+            meta, _ = s._stats({}, {})
+            sizes.append(meta["tables"][list(spec.host_io)[0]])
+        assert all(n > 0 for n in sizes), f"shard sizes {sizes}"
+        # Rank 0's final checkpoint fanned a Save out: per-shard files exist.
+        root = tmp_path / "ckpt" / "host_stores"
+        steps = sorted(os.listdir(root), key=int)
+        assert steps, "no PS snapshot written"
+        key = list(spec.host_io)[0]
+        for s in range(2):
+            assert (root / steps[-1] / snapshot_filename(key, s, 2)).exists()
+    finally:
+        stop.set()
+        for p in procs.values():
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+        for s in ps_servers:
+            s.stop()
+        server.stop()
+
+
 def test_parse_ps_addresses():
     assert parse_ps_addresses("a:1, b:2 ,,c:3") == ["a:1", "b:2", "c:3"]
     assert parse_ps_addresses("") == []
