@@ -22,7 +22,10 @@ Design (static shapes, XLA/ICI-friendly — see SURVEY.md §7 item 5):
   per-row while loop* (212,992 iterations/step at ~2-3 µs each; this was
   round 2's entire ~200x throughput gap).  An unpacked 2-D ``[V, 8]`` table
   vectorizes too but wastes 15/16 of each vreg on the scatter (18.2 ms); a
-  one-hot-matmul lookup costs 20 ms of MXU time.  Trace-derived numbers, not
+  one-hot-matmul lookup costs 20 ms of MXU time.  bf16 rows do NOT help:
+  the scatter-add is op-rate-bound (~13 ns/row whether the physical row is
+  256 B or 512 B — measured 2.97 ms bf16 vs 2.75 ms f32), so tables stay
+  f32 (see docs/perf.md).  Trace-derived numbers, not
   wall-clock micros (the tunneled chip's dispatch wall-clock is bimodal and
   untrustworthy — VERDICT r2 Weak #2); reproduce with
   ``tools/gather_experiments.py``.

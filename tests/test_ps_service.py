@@ -431,16 +431,18 @@ def test_two_process_world_trains_against_ps_fleet(tmp_path):
 
     threading.Thread(target=reap, daemon=True).start()
 
+    # ONE source of truth for the model shape: the PS fleet builds its
+    # stores from the spec parsed out of the same string the workers get.
     model_params = (
         'buckets_per_feature=64;embedding_dim=8;hidden=[16];'
         'host_tier=true;compute_dtype="float32"'
     )
+    from elasticdl_tpu.common.config import _parse_kv_string
     from elasticdl_tpu.models.spec import load_model_spec
 
     spec = load_model_spec(
         "elasticdl_tpu.models", "deepfm.model_spec",
-        buckets_per_feature=64, embedding_dim=8, hidden=(16,),
-        host_tier=True, compute_dtype="float32",
+        **_parse_kv_string(model_params),
     )
     ps_servers = [
         PSServer(spec.host_io, shard=s, num_shards=2).start() for s in range(2)
@@ -476,9 +478,10 @@ def test_two_process_world_trains_against_ps_fleet(tmp_path):
         env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
         env.pop("PALLAS_AXON_POOL_IPS", None)
         log = open(tmp_path / f"{worker_id}.log", "w")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         return subprocess.Popen(
             [_sys.executable, "-m", "elasticdl_tpu.worker.main"],
-            env=env, stdout=log, stderr=subprocess.STDOUT, cwd="/root/repo",
+            env=env, stdout=log, stderr=subprocess.STDOUT, cwd=repo,
         )
 
     def _log_tail(w):

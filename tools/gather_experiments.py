@@ -55,17 +55,6 @@ def take2d_fill(table2d, ids):
     return jnp.take(table2d, ids, axis=0, mode="fill", fill_value=jnp.nan)
 
 
-def packed_lookup(packed, ids):
-    """[V/16, 128] packed rows: gather full 128-lane rows, lane-select."""
-    hi = ids // PACK                   # physical row
-    lo = ids % PACK                    # lane group
-    rows = jnp.take(packed, hi.reshape(-1), axis=0)        # [B*F, 128]
-    rows = rows.reshape(B * F, PACK, DIM)
-    sel = jax.nn.one_hot(lo.reshape(-1), PACK, dtype=rows.dtype)  # [B*F, 16]
-    out = jnp.einsum("npd,np->nd", rows, sel)
-    return out.reshape(B, F, DIM)
-
-
 def onehot_matmul(table3d, ids):
     """Per-feature one-hot matmul: [B, BUCKETS] @ [BUCKETS, DIM] on the MXU.
 
@@ -77,11 +66,42 @@ def onehot_matmul(table3d, ids):
     return out.astype(jnp.float32)
 
 
+def packed_lookup_width(packed, ids, width):
+    """Packed rows of an arbitrary element width (dtype from the table):
+    gather full physical rows, lane-select.  width=128 f32 is the shipped
+    layout; bf16 at width 128 halves bytes/row (256B), bf16 at width 256
+    keeps 512B rows with double pack."""
+    pack = width // DIM
+    hi = ids // pack
+    lo = ids % pack
+    rows = jnp.take(packed, hi.reshape(-1), axis=0)        # [B*F, width]
+    rows = rows.reshape(B * F, pack, DIM)
+    sel = jax.nn.one_hot(lo.reshape(-1), pack, dtype=rows.dtype)
+    out = jnp.einsum("npd,np->nd", rows, sel)
+    return out.reshape(B, F, DIM)
+
+
+def _packed_table(key, width, dtype=jnp.float32):
+    rows = V // (width // DIM)
+    return jax.random.normal(key, (rows, width)).astype(dtype)
+
+
 VARIANTS = {
     "flat": (lambda key: jax.random.normal(key, (V * DIM,)), flat_lookup),
     "take2d_clip": (lambda key: jax.random.normal(key, (V, DIM)), take2d_clip),
     "take2d_fill": (lambda key: jax.random.normal(key, (V, DIM)), take2d_fill),
-    "packed": (lambda key: jax.random.normal(key, (V // PACK, 128)), packed_lookup),
+    "packed": (
+        lambda key: _packed_table(key, 128),
+        lambda t, ids: packed_lookup_width(t, ids, 128),
+    ),
+    "packed_bf16_w128": (
+        lambda key: _packed_table(key, 128, jnp.bfloat16),
+        lambda t, ids: packed_lookup_width(t, ids, 128),
+    ),
+    "packed_bf16_w256": (
+        lambda key: _packed_table(key, 256, jnp.bfloat16),
+        lambda t, ids: packed_lookup_width(t, ids, 256),
+    ),
     "onehot": (lambda key: jax.random.normal(key, (F, BUCKETS, DIM)), onehot_matmul),
 }
 
