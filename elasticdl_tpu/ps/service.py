@@ -50,6 +50,7 @@ import json
 import os
 import struct
 import threading
+import time
 from concurrent import futures
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -458,6 +459,21 @@ class RemoteEmbeddingStore:
     legalizes host-tier tables on multi-process meshes.
     """
 
+    #: Pull/PushGrad retry schedule across a PS shard relaunch: the master
+    #: relaunches a crashed shard in seconds (and the relaunched pod restores
+    #: its slice from the newest snapshot), so briefly retrying bridges the
+    #: gap instead of failing the worker's task — the reference worker's PS
+    #: RPC retry plays the same role.
+    RETRY_BACKOFFS_S = (1.0, 2.0, 4.0, 8.0)
+
+    #: Status codes worth retrying: the shard is relaunching (UNAVAILABLE)
+    #: or the call timed out in flight.  Anything else (INVALID_ARGUMENT,
+    #: FAILED_PRECONDITION) is a real error and surfaces immediately.
+    TRANSIENT_CODES = (
+        grpc.StatusCode.UNAVAILABLE,
+        grpc.StatusCode.DEADLINE_EXCEEDED,
+    )
+
     def __init__(self, table: str, dim: int, addresses: Sequence[str]):
         if not addresses:
             raise ValueError("RemoteEmbeddingStore needs >= 1 PS address")
@@ -465,6 +481,23 @@ class RemoteEmbeddingStore:
         self.dim = dim
         self._clients = [PSClient(a) for a in addresses]
         self.num_shards = len(self._clients)
+
+    def _retry(self, fn):
+        """Run ``fn()``, retrying transient shard outages (UNAVAILABLE — the
+        pod is relaunching — or a timed-out call).  Non-transient codes
+        (INVALID_ARGUMENT etc.) surface immediately."""
+        for i, backoff in enumerate(self.RETRY_BACKOFFS_S):
+            try:
+                return fn()
+            except grpc.RpcError as e:
+                if e.code() not in self.TRANSIENT_CODES:
+                    raise
+                logger.warning(
+                    "PS call failed (%s), retry %d/%d in %.0fs",
+                    e.code(), i + 1, len(self.RETRY_BACKOFFS_S), backoff,
+                )
+                time.sleep(backoff)
+        return fn()
 
     def wait_ready(self, timeout_s: float = 20.0) -> None:
         for c in self._clients:
@@ -482,27 +515,47 @@ class RemoteEmbeddingStore:
         parts = [np.nonzero(owner == s)[0] for s in range(self.num_shards)]
         return parts
 
+    def _call_shard(self, s: int, method: str, arrays: Dict[str, np.ndarray]):
+        """Synchronous shard call with the transient-outage retry."""
+        return self._retry(
+            lambda: self._clients[s].call(method, {"table": self.table}, arrays)
+        )
+
+    def _fan_out(self, method: str, shard_arrays: List[Tuple[int, Dict[str, np.ndarray]]]):
+        """Issue one call per shard in parallel; a shard whose FUTURE fails
+        transiently is retried synchronously (the other shards' results are
+        kept — for PushGrad a failed future means the shard never applied,
+        so the retry cannot double-apply; a response lost AFTER the apply
+        can double-apply, which async-PS semantics tolerate, as the
+        reference's at-least-once push does).  Returns [(shard, meta,
+        arrays)] in input order."""
+        futs = [
+            (s, arrs, self._clients[s].call_async(method, {"table": self.table}, arrs))
+            for s, arrs in shard_arrays
+        ]
+        results = []
+        for s, arrs, fut in futs:
+            try:
+                meta, arrays = decode_frame(fut.result())
+            except grpc.RpcError as e:
+                if e.code() not in self.TRANSIENT_CODES:
+                    raise
+                meta, arrays = self._call_shard(s, method, arrs)
+            results.append((s, meta, arrays))
+        return results
+
     def pull(self, ids: np.ndarray) -> np.ndarray:
         ids = np.ascontiguousarray(ids, np.int64)
         flat = ids.ravel()
         out = np.empty((flat.size, self.dim), np.float32)
         if self.num_shards == 1:
-            _, arrays = self._clients[0].call(
-                "Pull", {"table": self.table}, {"ids": flat}
-            )
+            _, arrays = self._call_shard(0, "Pull", {"ids": flat})
             out[:] = arrays["rows"]
             return out.reshape(ids.shape + (self.dim,))
         parts = self._partition(flat)
-        futs = [
-            (idx, self._clients[s].call_async(
-                "Pull", {"table": self.table}, {"ids": flat[idx]}
-            ))
-            for s, idx in enumerate(parts)
-            if idx.size
-        ]
-        for idx, fut in futs:
-            _, arrays = decode_frame(fut.result())
-            out[idx] = arrays["rows"]
+        work = [(s, {"ids": flat[idx]}) for s, idx in enumerate(parts) if idx.size]
+        for s, _, arrays in self._fan_out("Pull", work):
+            out[parts[s]] = arrays["rows"]
         return out.reshape(ids.shape + (self.dim,))
 
     def push_grad(self, ids: np.ndarray, grads: np.ndarray) -> None:
@@ -511,43 +564,43 @@ class RemoteEmbeddingStore:
             ids.size, self.dim
         )
         if self.num_shards == 1:
-            self._clients[0].call(
-                "PushGrad", {"table": self.table},
-                {"ids": ids, "grads": grads},
-            )
+            self._call_shard(0, "PushGrad", {"ids": ids, "grads": grads})
             return
         parts = self._partition(ids)
-        futs = [
-            self._clients[s].call_async(
-                "PushGrad", {"table": self.table},
-                {"ids": ids[idx], "grads": grads[idx]},
-            )
+        work = [
+            (s, {"ids": ids[idx], "grads": grads[idx]})
             for s, idx in enumerate(parts)
             if idx.size
         ]
-        for fut in futs:
-            fut.result()
+        self._fan_out("PushGrad", work)
 
     # -- checkpoint fan-out (each shard dumps/loads its own slice) --
 
     def save_snapshot(self, directory: str, step: int, keep_max: int = 3) -> None:
-        futs = [
-            c.call_async(
-                "Save",
-                {"directory": directory, "step": int(step), "keep_max": keep_max},
-            )
-            for c in self._clients
-        ]
-        for fut in futs:
-            fut.result()
+        # Same transient-outage retry as Pull/PushGrad: a checkpoint boundary
+        # landing inside a shard's relaunch window must wait the seconds out,
+        # not fail the worker's task.  Save is idempotent (atomic per-file
+        # replace), so a retry after a lost response just rewrites the file.
+        meta = {"directory": directory, "step": int(step), "keep_max": keep_max}
+        futs = [c.call_async("Save", meta) for c in self._clients]
+        for s, fut in enumerate(futs):
+            try:
+                fut.result()
+            except grpc.RpcError as e:
+                if e.code() not in self.TRANSIENT_CODES:
+                    raise
+                self._retry(lambda: self._clients[s].call("Save", meta))
 
     def load_snapshot(self, directory: str, step: int, strict: bool = True) -> bool:
         loaded = []
         for c in self._clients:
             try:
-                meta, _ = c.call(
-                    "Load",
-                    {"directory": directory, "step": int(step), "strict": strict},
+                meta, _ = self._retry(
+                    lambda: c.call(
+                        "Load",
+                        {"directory": directory, "step": int(step),
+                         "strict": strict},
+                    )
                 )
                 loaded.append(bool(meta.get("loaded", True)))
             except grpc.RpcError as e:

@@ -119,6 +119,27 @@ class TestPodManifest:
         assert manifest["spec"]["restartPolicy"] == "Never"
         assert {"name": "A", "value": "1"} in container["env"]
 
+    def test_ps_pod_manifest_shape(self):
+        """PS shard pods: CPU-only, stable per-SLOT hostname under the
+        headless <job>-ps subdomain (a relaunched shard keeps its DNS name
+        even though the pod name carries a generation suffix)."""
+        from elasticdl_tpu.master.pod_manager import render_ps_pod_manifest
+
+        config = JobConfig(job_name="deepfm")
+        manifest = render_ps_pod_manifest(
+            config, "deepfm-ps-1-r2", {"ELASTICDL_WORKER_SLOT": "1"}
+        )
+        container = manifest["spec"]["containers"][0]
+        assert "resources" not in container  # no TPU request
+        assert "nodeSelector" not in manifest["spec"]
+        assert manifest["spec"]["hostname"] == "deepfm-ps-1"
+        assert manifest["spec"]["subdomain"] == "deepfm-ps"
+        assert container["command"] == [
+            "python", "-m", "elasticdl_tpu.ps.main"
+        ]
+        labels = manifest["metadata"]["labels"]
+        assert labels["elasticdl-replica-type"] == "ps"
+
 
 def _job_config(tmp_path, **kwargs):
     train = str(tmp_path / "train.rio")

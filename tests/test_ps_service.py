@@ -545,6 +545,85 @@ def test_two_process_world_trains_against_ps_fleet(tmp_path):
         server.stop()
 
 
+@needs_native
+@pytest.mark.slow
+def test_ps_pod_crash_relaunch_restores_and_job_finishes(tmp_path):
+    """Chaos: SIGKILL a PS shard mid-job.  The master's relaunch policy
+    restarts it on the SAME port, the relaunched pod restores its slice from
+    the newest snapshot (ps/main.py), the workers' RemoteEmbeddingStore
+    retry bridges the outage, and the job drains to completion."""
+    import signal
+    import sys as _sys
+    import threading
+    import time
+
+    from elasticdl_tpu.data.synthetic import generate
+    from elasticdl_tpu.master.main import Master
+    from elasticdl_tpu.master.pod_manager import ProcessPodBackend
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker_entry = tmp_path / "worker_entry.py"
+    worker_entry.write_text(WORKER_PY.format(repo=repo))
+    ps_entry = tmp_path / "ps_entry.py"
+    ps_entry.write_text(PS_PY.format(repo=repo))
+
+    data = str(tmp_path / "criteo.rio")
+    generate("criteo", data, 128)
+    config = JobConfig(
+        job_name="pschaos",
+        model_def="deepfm.model_spec",
+        model_params=(
+            'buckets_per_feature=64;embedding_dim=8;hidden=[16];'
+            'host_tier=true;compute_dtype="float32"'
+        ),
+        distribution_strategy=DistributionStrategy.PARAMETER_SERVER,
+        training_data=data,
+        minibatch_size=16,
+        num_minibatches_per_task=1,
+        num_workers=1,
+        num_ps_pods=1,
+        num_epochs=3,
+        checkpoint_steps=2,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        max_worker_relaunch=4,
+    )
+    ps_backend = ProcessPodBackend(argv=[_sys.executable, str(ps_entry)])
+    master = Master(
+        config,
+        pod_backend=ProcessPodBackend(argv=[_sys.executable, str(worker_entry)]),
+        ps_backend=ps_backend,
+    )
+    result = {}
+
+    def run():
+        result["status"] = master.run(poll_interval_s=0.1)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    try:
+        # Wait for the first host-store snapshot, then kill the PS shard.
+        root = tmp_path / "ckpt" / "host_stores"
+        deadline = time.time() + 120
+        while time.time() < deadline and not (
+            root.exists() and os.listdir(root)
+        ):
+            time.sleep(0.2)
+        assert root.exists() and os.listdir(root), "no snapshot before kill"
+        pid = ps_backend.pid("pschaos-ps-0")
+        assert pid is not None, "PS pod not running"
+        os.kill(pid, signal.SIGKILL)
+
+        t.join(timeout=240)
+        assert not t.is_alive(), "job did not finish after PS crash"
+        assert result["status"]["finished"], result["status"]
+        assert result["status"]["done"] == 24  # 8 tasks x 3 epochs
+        # The relaunched shard really is a second generation of the slot.
+        relaunched = master.ps_manager.pod_info("pschaos-ps-0-r1")
+        assert relaunched is not None, "PS pod was not relaunched"
+    finally:
+        master.shutdown()
+
+
 def test_parse_ps_addresses():
     assert parse_ps_addresses("a:1, b:2 ,,c:3") == ["a:1", "b:2", "c:3"]
     assert parse_ps_addresses("") == []
