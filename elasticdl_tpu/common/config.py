@@ -100,6 +100,13 @@ class JobConfig:
     # spans every worker's chips.  Leave False for single-host jobs.
     multihost: bool = False
     coordinator_port: int = 8476
+    # Hierarchical mesh (parallel/mesh.py): > 1 builds a 2-D (dp, ep) mesh
+    # whose outer dp axis strides across hosts/slices — gradient psums ride
+    # DCN, but embedding tables shard over the inner ep axis so the
+    # latency-sensitive ragged all-to-all stays on ICI within a slice.
+    # 1 (default) keeps the flat 1-D mesh.  Must divide the device count
+    # (elastic resizes that break divisibility fall back to 1-D).
+    dcn_data_parallelism: int = 1
 
     # --- elasticity ---
     relaunch_on_worker_failure: bool = True
@@ -151,6 +158,8 @@ class JobConfig:
             )
         if self.num_ps_pods < 0:
             raise ValueError("--num_ps_pods cannot be negative")
+        if self.dcn_data_parallelism < 1:
+            raise ValueError("--dcn_data_parallelism must be >= 1")
         # Kept in sync with ops.embedding.LOOKUP_IMPLS (asserted by tests);
         # not imported from there so this module stays jax-free (the master
         # control plane and pod manager must run without jax).

@@ -3,11 +3,21 @@ Horovod communicator (RendezvousServer + NCCL/Gloo ring [D: BASELINE.json
 north_star]; reference sources unverifiable, mount empty at survey time).
 
 Where the reference re-forms an NCCL ring when workers join/leave, we re-form
-a ``jax.sharding.Mesh`` over the currently-live devices.  The mesh is 1-D with
-axis ``"dp"``: data parallelism shards the batch over it, and (in
-ParameterServer strategy) embedding tables are row-sharded over the *same*
-axis — on TPU the "parameter server" is simply the HBM of the same chips that
-compute, and lookups ride ICI collectives instead of gRPC.
+a ``jax.sharding.Mesh`` over the currently-live devices.  Two shapes:
+
+- **1-D** (default), axis ``"dp"``: data parallelism shards the batch over
+  it, and (in ParameterServer strategy) embedding tables are row-sharded
+  over the *same* axis — on TPU the "parameter server" is simply the HBM of
+  the same chips that compute, and lookups ride ICI collectives instead of
+  gRPC.
+- **2-D hierarchical** (``dcn_parallelism > 1``), axes ``("dp", "ep")``:
+  the outer ``dp`` axis strides across HOSTS (slices) — its only collective
+  is the gradient psum, which tolerates DCN latency — while embedding
+  tables shard over the inner ``ep`` axis, keeping the latency-sensitive
+  ragged all-to-all entirely on ICI within a slice.  Device order from
+  ``jax.devices()`` groups each process's devices contiguously, so
+  ``reshape(dcn, -1)`` puts one process (or group of processes) per ``dp``
+  row by construction.
 """
 
 from __future__ import annotations
@@ -19,18 +29,23 @@ import numpy as np
 from jax.sharding import Mesh
 
 DATA_AXIS = "dp"
+EMBED_AXIS = "ep"
 
 
 def create_mesh(
     devices: Optional[Sequence[jax.Device]] = None,
     num_devices: Optional[int] = None,
     axis_name: str = DATA_AXIS,
+    dcn_parallelism: int = 1,
 ) -> Mesh:
-    """Build a 1-D mesh over ``devices`` (default: all local devices).
+    """Build a mesh over ``devices`` (default: all local devices).
 
     ``num_devices`` takes a prefix of the available devices — used by the
     elastic path to form smaller meshes after a worker leaves, and by tests to
     emulate 4->8->4 scaling on a fixed pool of fake CPU devices.
+
+    ``dcn_parallelism > 1`` builds the 2-D hierarchical ``(dp, ep)`` mesh
+    (see module docstring); it must divide the device count.
     """
     if devices is None:
         devices = jax.devices()
@@ -41,7 +56,15 @@ def create_mesh(
                 f"requested {num_devices} devices, only {len(devices)} available"
             )
         devices = devices[:num_devices]
-    return Mesh(np.asarray(devices), (axis_name,))
+    if dcn_parallelism <= 1:
+        return Mesh(np.asarray(devices), (axis_name,))
+    if len(devices) % dcn_parallelism:
+        raise ValueError(
+            f"dcn_parallelism {dcn_parallelism} does not divide "
+            f"{len(devices)} devices"
+        )
+    arr = np.asarray(devices).reshape(dcn_parallelism, -1)
+    return Mesh(arr, (axis_name, EMBED_AXIS))
 
 
 class MeshManager:
@@ -54,8 +77,13 @@ class MeshManager:
     sizes cheap).
     """
 
-    def __init__(self, devices: Optional[Sequence[jax.Device]] = None):
+    def __init__(
+        self,
+        devices: Optional[Sequence[jax.Device]] = None,
+        dcn_parallelism: int = 1,
+    ):
         self._pool = list(devices) if devices is not None else list(jax.devices())
+        self._dcn = dcn_parallelism
         self._mesh: Optional[Mesh] = None
         self._version = -1
 
@@ -71,7 +99,9 @@ class MeshManager:
         return self._version
 
     def reform(self, num_devices: int, version: int) -> Mesh:
-        self._mesh = create_mesh(self._pool, num_devices=num_devices)
+        self._mesh = create_mesh(
+            self._pool, num_devices=num_devices, dcn_parallelism=self._dcn
+        )
         self._version = version
         return self._mesh
 

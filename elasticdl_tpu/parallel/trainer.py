@@ -106,11 +106,21 @@ def opt_state_partition_specs(
     )
 
 
-def _tree_psum_except(tree: Any, skip_paths, axis_name: str):
+def _tree_psum_except(tree: Any, skip_paths, axes, skip_axes):
+    """psum ``tree`` over ``axes``, except leaves at ``skip_paths`` which
+    psum over ``skip_axes`` only (empty = left alone).
+
+    Dense grads sum over every mesh axis; sharded-table grads come out of
+    the collective lookup's transpose already summed WITHIN the embedding
+    axis, so on a hierarchical mesh they still need the data-parallel axes'
+    contribution (each dp replica saw different examples) — but psum'ing
+    them over the embedding axis too would multiply the gradient by its
+    size."""
+
     def maybe_psum(path, leaf):
         if _path_keys(path) in skip_paths:
-            return leaf
-        return lax.psum(leaf, axis_name)
+            return lax.psum(leaf, skip_axes) if skip_axes else leaf
+        return lax.psum(leaf, axes)
 
     return jax.tree_util.tree_map_with_path(maybe_psum, tree)
 
@@ -157,7 +167,7 @@ class Trainer:
         self.spec = spec
         self.config = config
         self.mesh = mesh
-        self.axis_name = mesh.axis_names[0]
+        self._adopt_mesh_axes(mesh)
         self.sharded_embeddings = (
             config.distribution_strategy == DistributionStrategy.PARAMETER_SERVER
             and bool(spec.embedding_tables)
@@ -216,6 +226,25 @@ class Trainer:
                     for key, io in spec.host_io.items()
                 }
 
+    def _adopt_mesh_axes(self, mesh: Mesh) -> None:
+        """Axis roles for 1-D and hierarchical meshes.
+
+        The batch shards over EVERY mesh axis; embedding tables shard over
+        the LAST axis only.  On a 1-D ``("dp",)`` mesh the two coincide (the
+        original design).  On a hierarchical ``("dp", "ep")`` mesh
+        (mesh.create_mesh dcn_parallelism > 1) the outer dp axis strides
+        across hosts/slices — its only collective is the grad psum, which
+        tolerates DCN — while the latency-sensitive embedding all-to-all
+        stays on the inner ICI axis.
+        """
+        self.batch_axes = tuple(mesh.axis_names)
+        self.axis_name = mesh.axis_names[-1]  # the embedding/table axis
+        if len(self.batch_axes) > 1 and self.spec.batch_shard_dim != 0:
+            raise NotImplementedError(
+                "hierarchical (dp, ep) meshes support data-parallel batches "
+                "only; sequence-parallel models use a 1-D mesh"
+            )
+
     def _make_ctx(self) -> ParallelContext:
         # Resolve "auto" against the MESH's platform (not the default
         # backend): tests build CPU meshes in a process whose default backend
@@ -230,7 +259,10 @@ class Trainer:
             embedding_impl=resolve_impl(
                 self.config.embedding_lookup_impl,
                 platform,
-                axis_size=self.mesh.devices.size,
+                # Tables shard over the LAST axis only; that is the size the
+                # collective lookup sees (a hierarchical mesh's dp axis never
+                # carries embedding traffic).
+                axis_size=self.mesh.shape[self.axis_name],
             ),
         )
 
@@ -243,7 +275,7 @@ class Trainer:
         after an Orbax restore on the new membership (see master.rendezvous).
         """
         self.mesh = mesh
-        self.axis_name = mesh.axis_names[0]
+        self._adopt_mesh_axes(mesh)
         self.ctx = self._make_ctx()
         self._state_specs = None
         self._train_step = None
@@ -294,14 +326,16 @@ class Trainer:
         return jax.tree.map(place, state, shardings)
 
     def _batch_spec_for(self, leaf) -> P:
-        """PartitionSpec for one batch leaf: the mesh axis shards dimension
+        """PartitionSpec for one batch leaf: EVERY mesh axis shards dimension
         ``spec.batch_shard_dim`` (0 = examples, 1 = sequence); leaves too
-        small to have that dimension (per-example masks under SP) replicate."""
+        small to have that dimension (per-example masks under SP) replicate.
+        On a hierarchical mesh the batch dim shards over (dp, ep) jointly —
+        each device still holds B/total examples."""
         d = self.spec.batch_shard_dim
         if d == 0:
-            return P(self.axis_name)
+            return P(self.batch_axes)
         if getattr(leaf, "ndim", 0) > d:
-            return P(*([None] * d), self.axis_name)
+            return P(*([None] * d), self.batch_axes)
         return P()
 
     def batch_specs(self, batch: Any):
@@ -363,7 +397,7 @@ class Trainer:
         """This process's contiguous [lo, hi) slice of the batch dimension
         under the data-parallel sharding (union of its addressable devices'
         index slices)."""
-        sh = NamedSharding(self.mesh, P(self.axis_name))
+        sh = NamedSharding(self.mesh, P(self.batch_axes))
         idx_map = sh.addressable_devices_indices_map((n_examples,))
         starts = [s[0].start or 0 for s in idx_map.values()]
         stops = [
@@ -551,6 +585,7 @@ class Trainer:
                 self.state_specs(),
                 host_keys=tuple(sorted(self.spec.host_io)),
                 batch_specs=self.batch_specs(batch),
+                batch_axes=self.batch_axes,
             )
         return self._train_step(state, batch)
 
@@ -562,6 +597,7 @@ class Trainer:
                 self.ctx,
                 self.state_specs(),
                 batch_specs=self.batch_specs(batch),
+                batch_axes=self.batch_axes,
             )
         return self._eval_step(state, batch)
 
@@ -573,6 +609,7 @@ class Trainer:
                 self.ctx,
                 self.state_specs(),
                 batch_specs=self.batch_specs(batch),
+                batch_axes=self.batch_axes,
             )
         return self._predict_step(state, batch)
 
@@ -584,21 +621,31 @@ def build_train_step(
     state_specs: TrainState,
     host_keys: Sequence[str] = (),
     batch_specs: Any = None,
+    batch_axes: Optional[Tuple[str, ...]] = None,
 ) -> Callable:
     """The jitted train step.  With ``host_keys`` (host-tier tables), the
     step ALSO differentiates with respect to those injected batch arrays and
     returns their cotangents as a third output, batch-sharded — the
     device-side half of the pull/step/push cycle (Trainer.run_train_step).
+
+    ``batch_axes`` lists every mesh axis the batch shards over (defaults to
+    just the embedding axis — the 1-D mesh).  Reductions of loss/metrics/
+    dense grads run over all of them; sharded-table grads get only the
+    NON-embedding axes' psum (their transpose already summed within the
+    embedding axis).
     """
     axis = ctx.axis_name
     assert axis is not None
-    # Paths of sharded-table grads (params-relative): these come out of the
-    # collective lookup's transpose already globally summed — psum'ing them
-    # again would multiply the gradient by the mesh size.
+    axes = tuple(batch_axes) if batch_axes else (axis,)
+    dcn_axes = tuple(a for a in axes if a != axis)
+    # Paths of sharded-table grads (params-relative): the collective
+    # lookup's transpose sums them within the embedding axis already.
     grad_skip = {t.path for t in spec.embedding_tables} if ctx.sharded_embeddings else set()
 
     def local_step(state: TrainState, batch):
-        n = lax.axis_size(axis)
+        n = 1
+        for a in axes:
+            n *= lax.axis_size(a)
         batch = dict(batch)
         host_in = {k: batch.pop(k) for k in host_keys}
 
@@ -611,11 +658,11 @@ def build_train_step(
         (loss, out), (grads, host_grads) = jax.value_and_grad(
             loss_fn, argnums=(0, 1), has_aux=True
         )(state.params, host_in)
-        grads = _tree_psum_except(grads, grad_skip, axis)
-        loss = lax.psum(loss, axis)
+        grads = _tree_psum_except(grads, grad_skip, axes, dcn_axes)
+        loss = lax.psum(loss, axes)
         updates, opt_state = spec.optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
-        metrics = {k: lax.pmean(v, axis) for k, v in spec.metrics(out, batch).items()}
+        metrics = {k: lax.pmean(v, axes) for k, v in spec.metrics(out, batch).items()}
         metrics["loss"] = loss
         new_state = TrainState(step=state.step + 1, params=params, opt_state=opt_state)
         if host_keys:
@@ -626,7 +673,7 @@ def build_train_step(
 
     out_specs: Tuple = (state_specs, P())
     if host_keys:
-        out_specs = (state_specs, P(), {k: P(axis) for k in host_keys})
+        out_specs = (state_specs, P(), {k: P(axes) for k in host_keys})
     mapped = shard_map(
         local_step,
         mesh=mesh,
@@ -643,6 +690,7 @@ def build_predict_step(
     ctx: ParallelContext,
     state_specs: TrainState,
     batch_specs: Any = None,
+    batch_axes: Optional[Tuple[str, ...]] = None,
 ) -> Callable:
     """Per-example model outputs, batch-sharded in and out (the reference's
     predict mode, SURVEY.md §2 #1 'predict')."""
@@ -653,13 +701,14 @@ def build_predict_step(
         return spec.apply(state.params, batch, train=False, ctx=ctx)
 
     d = spec.batch_shard_dim
+    axes = tuple(batch_axes) if batch_axes else (axis,)
     mapped = shard_map(
         local_predict,
         mesh=mesh,
         in_specs=(state_specs, batch_specs if batch_specs is not None else P(axis)),
         # Per-example outputs shard on the model's batch dimension (the
         # sequence dim for SP models).
-        out_specs=P(*([None] * d), axis),
+        out_specs=P(*([None] * d), axes),
         check_vma=False,
     )
     return jax.jit(mapped)
@@ -671,9 +720,11 @@ def build_eval_step(
     ctx: ParallelContext,
     state_specs: TrainState,
     batch_specs: Any = None,
+    batch_axes: Optional[Tuple[str, ...]] = None,
 ) -> Callable:
     axis = ctx.axis_name
     assert axis is not None
+    axes = tuple(batch_axes) if batch_axes else (axis,)
     # Tail-chunk correctness: the worker wrap-pads the last eval chunk to the
     # static minibatch size and marks real rows in ``__mask__``.  Metrics
     # functions that accept a mask compute means over real examples only;
@@ -690,11 +741,11 @@ def build_eval_step(
         if mask is not None and wants_mask:
             metrics = spec.metrics(out, batch, mask=mask)
             count = jnp.sum(mask.astype(jnp.float32))
-            total = jnp.maximum(lax.psum(count, axis), 1e-12)
+            total = jnp.maximum(lax.psum(count, axes), 1e-12)
             return {
-                k: lax.psum(v * count, axis) / total for k, v in metrics.items()
+                k: lax.psum(v * count, axes) / total for k, v in metrics.items()
             }
-        return {k: lax.pmean(v, axis) for k, v in spec.metrics(out, batch).items()}
+        return {k: lax.pmean(v, axes) for k, v in spec.metrics(out, batch).items()}
 
     mapped = shard_map(
         local_eval,

@@ -1,0 +1,215 @@
+"""Hierarchical (dp, ep) mesh: data parallelism over the outer DCN-striding
+axis, embedding tables sharded over the inner ICI axis (parallel/mesh.py).
+
+The correctness bar: every observable — losses, trained tables, eval
+metrics, predictions — must match the flat 1-D mesh exactly (same devices,
+same seed, same batches); the hierarchy only changes WHICH collectives move
+the data (grad psum over dp+ep, embedding all-to-all over ep alone).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elasticdl_tpu.common.config import DistributionStrategy, JobConfig
+from elasticdl_tpu.models.spec import load_model_spec
+from elasticdl_tpu.parallel.mesh import create_mesh
+from elasticdl_tpu.parallel.trainer import Trainer
+
+
+def _deepfm(**over):
+    kw = dict(
+        buckets_per_feature=64, embedding_dim=8, hidden=(16,),
+        compute_dtype="float32",
+    )
+    kw.update(over)
+    return load_model_spec("elasticdl_tpu.models", "deepfm.model_spec", **kw)
+
+
+def _batch(n=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "dense": rng.rand(n, 13).astype(np.float32) * 100,
+        "cat": rng.randint(0, 1 << 20, (n, 26)).astype(np.int64),
+        "labels": rng.randint(0, 2, (n,)).astype(np.int32),
+    }
+
+
+def _train(trainer, steps=3):
+    state = trainer.init_state(jax.random.key(0))
+    losses = []
+    for s in range(steps):
+        state, m = trainer.train_step(state, trainer.shard_batch(_batch(seed=s)))
+        losses.append(float(m["loss"]))
+    return losses, state
+
+
+def test_mesh_shapes(devices):
+    m = create_mesh(devices, dcn_parallelism=2)
+    assert m.axis_names == ("dp", "ep")
+    assert dict(m.shape) == {"dp": 2, "ep": 4}
+    with pytest.raises(ValueError, match="does not divide"):
+        create_mesh(devices[:6], dcn_parallelism=4)
+    assert create_mesh(devices).axis_names == ("dp",)
+
+
+def test_ps_training_matches_flat_mesh(devices):
+    """Sharded-table (PS strategy) training on 2x4 and 4x2 meshes tracks the
+    flat 8-device mesh loss-for-loss, and the trained table agrees."""
+    spec = _deepfm()
+    cfg = JobConfig(
+        distribution_strategy=DistributionStrategy.PARAMETER_SERVER,
+        embedding_lookup_impl="ragged_emulated",
+    )
+    flat_losses, flat_state = _train(Trainer(spec, cfg, create_mesh(devices)))
+    for dcn in (2, 4):
+        mesh = create_mesh(devices, dcn_parallelism=dcn)
+        losses, state = _train(Trainer(spec, cfg, mesh))
+        np.testing.assert_allclose(losses, flat_losses, rtol=1e-5)
+        np.testing.assert_allclose(
+            jax.device_get(state.params["fm_table"]),
+            jax.device_get(flat_state.params["fm_table"]),
+            rtol=1e-5, atol=1e-7,
+        )
+
+
+def test_table_sharded_over_inner_axis_only(devices):
+    """The table's sharding names ONLY the ep axis — the dp axis never
+    carries embedding traffic (each dp replica holds the same rows)."""
+    spec = _deepfm()
+    cfg = JobConfig(
+        distribution_strategy=DistributionStrategy.PARAMETER_SERVER,
+        embedding_lookup_impl="ragged_emulated",
+    )
+    trainer = Trainer(spec, cfg, create_mesh(devices, dcn_parallelism=2))
+    state = trainer.init_state(jax.random.key(0))
+    table_spec = state.params["fm_table"].sharding.spec
+    assert tuple(table_spec) == ("ep",)
+    assert trainer.ctx.axis_name == "ep"
+    # auto would resolve against the EP axis size (4), not the mesh size (8).
+    from elasticdl_tpu.ops.embedding import resolve_impl
+
+    assert resolve_impl("auto", "tpu", axis_size=4) == "ragged"
+
+
+def test_allreduce_strategy_on_hierarchical_mesh(devices):
+    """AllReduce (no sharded tables): grads psum over BOTH axes — mnist
+    trains to the same losses as the flat mesh."""
+    spec = load_model_spec(
+        "elasticdl_tpu.models", "mnist.model_spec", compute_dtype="float32"
+    )
+    cfg = JobConfig(distribution_strategy=DistributionStrategy.ALLREDUCE)
+    rng = np.random.RandomState(0)
+    batch = {
+        "images": rng.rand(16, 28, 28, 1).astype(np.float32),
+        "labels": rng.randint(0, 10, (16,)).astype(np.int32),
+    }
+
+    def run(mesh):
+        tr = Trainer(spec, cfg, mesh)
+        st = tr.init_state(jax.random.key(0))
+        out = []
+        for _ in range(3):
+            st, m = tr.train_step(st, tr.shard_batch(dict(batch)))
+            out.append(float(m["loss"]))
+        return out
+
+    np.testing.assert_allclose(
+        run(create_mesh(devices, dcn_parallelism=2)),
+        run(create_mesh(devices)),
+        rtol=1e-5,
+    )
+
+
+def test_eval_and_predict_match_flat(devices):
+    spec = _deepfm()
+    cfg = JobConfig(
+        distribution_strategy=DistributionStrategy.PARAMETER_SERVER,
+        embedding_lookup_impl="ragged_emulated",
+    )
+    batch = _batch()
+    flat = Trainer(spec, cfg, create_mesh(devices))
+    hier = Trainer(spec, cfg, create_mesh(devices, dcn_parallelism=2))
+    fs = flat.init_state(jax.random.key(0))
+    hs = hier.init_state(jax.random.key(0))
+    fm = {k: float(v) for k, v in flat.eval_step(fs, flat.shard_batch(dict(batch))).items()}
+    hm = {k: float(v) for k, v in hier.eval_step(hs, hier.shard_batch(dict(batch))).items()}
+    assert fm.keys() == hm.keys()
+    for k in fm:
+        np.testing.assert_allclose(hm[k], fm[k], rtol=1e-5)
+    fp = jax.device_get(flat.predict_step(fs, flat.shard_batch(dict(batch))))
+    hp = jax.device_get(hier.predict_step(hs, hier.shard_batch(dict(batch))))
+    np.testing.assert_allclose(hp, fp, rtol=1e-5)
+
+
+def test_masked_eval_tail_exact_on_hierarchical(devices):
+    """The exact-tail eval contract (psum-weighted masked means) holds over
+    2-D meshes: metrics over a wrap-padded batch with __mask__ equal the
+    unpadded single-device values."""
+    spec = _deepfm()
+    cfg = JobConfig(
+        distribution_strategy=DistributionStrategy.PARAMETER_SERVER,
+        embedding_lookup_impl="ragged_emulated",
+    )
+    real = _batch(n=10)
+    padded = {k: np.concatenate([v, v[: 16 - 10]]) for k, v in real.items()}
+    padded["__mask__"] = np.concatenate(
+        [np.ones(10, np.float32), np.zeros(6, np.float32)]
+    )
+    hier = Trainer(spec, cfg, create_mesh(devices, dcn_parallelism=2))
+    hs = hier.init_state(jax.random.key(0))
+    got = {
+        k: float(v)
+        for k, v in hier.eval_step(hs, hier.shard_batch(padded)).items()
+    }
+    # Ground truth: unsharded forward over the REAL rows only.
+    params = jax.device_get(hs).params
+    out = spec.apply(params, real, train=False)
+    want = {k: float(v) for k, v in spec.metrics(jnp.asarray(out), real).items()}
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], rtol=1e-5, atol=1e-6)
+
+
+def test_host_tier_on_hierarchical_mesh(devices):
+    """Host-tier pull/push works over a 2-D mesh (host grads come back
+    sharded over (dp, ep) jointly); loss matches the flat-mesh host-tier
+    run."""
+    pytest.importorskip("elasticdl_tpu.ps.host_store")
+    from elasticdl_tpu.ps.host_store import native_lib_available
+
+    if not native_lib_available():
+        pytest.skip("native lib unavailable")
+    spec = _deepfm(host_tier=True)
+    assert spec.host_io
+    cfg = JobConfig(distribution_strategy=DistributionStrategy.PARAMETER_SERVER)
+
+    def run(mesh):
+        tr = Trainer(spec, cfg, mesh)
+        st = tr.init_state(jax.random.key(0))
+        out = []
+        for s in range(3):
+            st, m = tr.run_train_step(st, _batch(seed=s))
+            out.append(float(m["loss"]))
+        return out
+
+    np.testing.assert_allclose(
+        run(create_mesh(devices, dcn_parallelism=2)),
+        run(create_mesh(devices)),
+        rtol=1e-5,
+    )
+
+
+def test_sp_model_rejects_hierarchical_mesh(devices):
+    spec = load_model_spec(
+        "elasticdl_tpu.models", "transformer_lm.model_spec",
+        vocab=128, dim=32, n_layers=1, n_heads=2, max_seq=64, seq_len=32,
+        compute_dtype="float32",
+    )
+    assert spec.batch_shard_dim == 1
+    with pytest.raises(NotImplementedError, match="1-D mesh"):
+        Trainer(
+            spec,
+            JobConfig(distribution_strategy=DistributionStrategy.ALLREDUCE),
+            create_mesh(devices, dcn_parallelism=2),
+        )

@@ -87,16 +87,19 @@ def _sorted_segments(ids, grads):
     # for a run ending at j (last position before next run or N-1), the run
     # sum = css[j] - css[start-1].  Take per-run sums at run STARTS.
     css = jnp.cumsum(srows, axis=0)
-    run_id = jnp.cumsum(first.astype(jnp.int32)) - 1          # [N]
-    # last position of each run = scatter-max of positions by run_id; instead
-    # use the "next run's start - 1" trick: positions of starts, shifted.
-    start_pos = jnp.nonzero(first, size=N, fill_value=N - 1)[0]   # [N] padded
+    # Run ends via the "next run's start - 1" trick.  Padding slots fill
+    # with N (NOT N-1): a fill of N-1 would masquerade as a real start at
+    # the last position and clip the LAST run's end to N-2, silently
+    # dropping the final sorted row from its segment sum.
+    start_pos = jnp.nonzero(first, size=N, fill_value=N)[0]       # [N] padded
     n_runs = jnp.sum(first.astype(jnp.int32))
-    end_pos = jnp.concatenate([start_pos[1:] - 1, jnp.array([N - 1])])
+    next_start = jnp.concatenate([start_pos[1:], jnp.array([N])])
+    end_pos = jnp.clip(next_start - 1, 0, N - 1)
+    safe_start = jnp.minimum(start_pos, N - 1)
     run_sums = css[end_pos] - jnp.where(
-        (start_pos == 0)[:, None], 0.0, css[jnp.maximum(start_pos - 1, 0)]
+        (safe_start == 0)[:, None], 0.0, css[jnp.maximum(safe_start - 1, 0)]
     )
-    run_rows = sids[start_pos]
+    run_rows = sids[safe_start]
     # Mask padded run slots (beyond n_runs) to sentinel P -> dropped.
     valid = jnp.arange(N) < n_runs
     run_rows = jnp.where(valid, run_rows, P)
