@@ -81,6 +81,13 @@ class JobConfig:
     # Mesh-sharded (HBM) tables never use PS pods; they shard over the whole
     # mesh by construction (ops/embedding.py).
     num_ps_pods: int = 0
+    # Async parameter-server mode (the reference's --use_async): host-tier
+    # row pulls for the next minibatch overlap the in-flight device step,
+    # reading rows one un-applied push stale (bounded staleness 1).  False =
+    # sync-by-version (every pull sees every prior push).  Only host-tier
+    # tables are affected: mesh-sharded tables and dense params live inside
+    # the jitted step and are always exact.
+    use_async: bool = False
     # host:port list of the PS shards, comma-separated, in shard order.  Set
     # by the master onto the worker pod env; settable by hand to point
     # workers at an externally managed PS fleet.

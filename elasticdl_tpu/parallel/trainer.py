@@ -27,7 +27,7 @@ from __future__ import annotations
 
 import inspect
 import os
-from typing import Any, Callable, Dict, List, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -428,16 +428,13 @@ class Trainer:
                 injected[key] = self._host_stores[key].pull(table_ids)
         return injected, ids
 
-    def run_train_step(self, state: TrainState, batch: Any):
-        """Full training step from a HOST batch: host-tier pull -> shard ->
-        jitted step -> sparse cotangent push.  Without host tables this is
-        just shard+step."""
-        if not self.spec.host_io:
-            return self.train_step(state, self.shard_batch(batch))
-        injected, ids = self._inject_host_rows(batch)
-        state, metrics, host_grads = self.train_step(
-            state, self.shard_batch(injected)
-        )
+    def _push_host_grads(self, ids: Dict[str, Any], host_grads: Dict[str, Any]):
+        """Push the step's sparse cotangents into the host-tier stores.
+
+        Materializing ``host_grads`` (np.asarray) BLOCKS on the step that
+        produced them — this is the synchronization point the async driver
+        (run_train_steps) moves past the next batch's pull.
+        """
         multi = self._is_multiprocess()
         for key, grads in host_grads.items():
             # The store applies its server-side optimizer per distinct id,
@@ -460,7 +457,59 @@ class Trainer:
                 )
             else:
                 self._host_stores[key].push_grad(ids[key], np.asarray(grads))
+
+    def run_train_step(self, state: TrainState, batch: Any):
+        """Full training step from a HOST batch: host-tier pull -> shard ->
+        jitted step -> sparse cotangent push.  Without host tables this is
+        just shard+step."""
+        if not self.spec.host_io:
+            return self.train_step(state, self.shard_batch(batch))
+        injected, ids = self._inject_host_rows(batch)
+        state, metrics, host_grads = self.train_step(
+            state, self.shard_batch(injected)
+        )
+        self._push_host_grads(ids, host_grads)
         return state, metrics
+
+    def run_train_steps(
+        self, state: TrainState, batches, use_async: bool = False
+    ):
+        """Train over an iterable of HOST batches.
+
+        ``use_async=False``: the synchronous loop — each batch's pull sees
+        every prior push (sync-by-version PS semantics).
+
+        ``use_async=True`` (host-tier tables only): the reference's async-PS
+        mode (SURVEY §2 #9 "async or sync-by-version") as a software
+        pipeline — batch ``n+1``'s row pull (host RPC) is issued BEFORE
+        blocking on batch ``n``'s cotangents, overlapping the pull with the
+        device step still in flight.  The pull therefore reads rows that are
+        one un-applied push stale, exactly the bounded-staleness contract of
+        an async parameter server; dense params stay exact (they live in the
+        jitted step).  With a single batch the pipeline degenerates to the
+        synchronous order, so short tasks are bit-identical to sync.
+
+        Returns (state, [metrics per batch]).
+        """
+        metrics_out = []
+        if not self.spec.host_io or not use_async:
+            for batch in batches:
+                state, metrics = self.run_train_step(state, batch)
+                metrics_out.append(metrics)
+            return state, metrics_out
+        pending = None  # (ids, host_grads) of the in-flight step
+        for batch in batches:
+            injected, ids = self._inject_host_rows(batch)
+            if pending is not None:
+                self._push_host_grads(*pending)
+            state, metrics, host_grads = self.train_step(
+                state, self.shard_batch(injected)
+            )
+            pending = (ids, host_grads)
+            metrics_out.append(metrics)
+        if pending is not None:
+            self._push_host_grads(*pending)
+        return state, metrics_out
 
     def run_eval_step(self, state: TrainState, batch: Any):
         if self.spec.host_io:

@@ -335,22 +335,30 @@ class Worker:
 
     def _run_training_task(self, task: Task) -> Dict[str, float]:
         records = list(self.reader.read_records(task.shard))
+        batches = (
+            self.spec.feed(chunk)
+            for chunk, _ in _minibatches(
+                records, self.config.minibatch_size, True
+            )
+        )
+        # run_train_steps = (host-tier pull ->) shard -> jitted step
+        # (-> sparse push) per batch; plain shard+step when no host tables.
+        # --use_async pipelines the host-tier pulls against the device step
+        # (the reference's async-PS mode — bounded staleness 1).
+        self.state, metrics_list = self.trainer.run_train_steps(
+            self.state, batches, use_async=self.config.use_async
+        )
+        # Aggregate across the task's minibatches (equal sizes — tails
+        # wrap-pad) instead of reporting only the last one's metrics.
+        # Accumulate the DEVICE scalars: a float() per step would block and
+        # kill async-dispatch pipelining; one transfer at task end suffices.
         sums: Dict[str, float] = {}
-        n_batches = 0
-        for chunk, _ in _minibatches(records, self.config.minibatch_size, True):
-            batch = self.spec.feed(chunk)
-            # run_train_step = (host-tier pull ->) shard -> jitted step
-            # (-> sparse push); plain shard+step when no host tables.
-            self.state, metrics = self.trainer.run_train_step(self.state, batch)
-            # Aggregate across the task's minibatches (equal sizes — tails
-            # wrap-pad) instead of reporting only the last one's metrics.
-            # Accumulate the DEVICE scalars: a float() here would block on
-            # every step and kill async-dispatch pipelining; one transfer at
-            # task end suffices.
-            n_batches += 1
+        for metrics in metrics_list:
             for k, v in metrics.items():
                 sums[k] = sums.get(k, 0.0) + v
-        return {k: float(s) / max(n_batches, 1) for k, s in sums.items()}
+        return {
+            k: float(s) / max(len(metrics_list), 1) for k, s in sums.items()
+        }
 
     def _run_evaluation_task(self, task: Task) -> tuple:
         records = list(self.reader.read_records(task.shard))
