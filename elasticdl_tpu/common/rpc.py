@@ -18,6 +18,14 @@ import grpc
 
 SERVICE_NAME = "elasticdl.Master"
 
+#: Wire-contract version, negotiated at RegisterWorker (the one RPC every
+#: worker must issue first).  Bump when a message's shape changes
+#: incompatibly; the master rejects a mismatched worker AT REGISTRATION with
+#: a structured error naming both versions — not N tasks later with a
+#: schema violation mid-job.  A request without the field is accepted
+#: (proto3 unknown-field stance: absent = pre-versioning peer).
+PROTOCOL_VERSION = 1
+
 
 @dataclasses.dataclass(frozen=True)
 class MessageSchema:
@@ -59,7 +67,8 @@ MASTER_SCHEMAS: Dict[str, MessageSchema] = {
         required={"model_version": _INT}, optional={"worker_id": _STR}
     ),
     "RegisterWorker": MessageSchema(
-        required={"worker_id": _STR}, optional={"address": _STR}
+        required={"worker_id": _STR},
+        optional={"address": _STR, "proto": _INT},
     ),
     "DeregisterWorker": MessageSchema(required={"worker_id": _STR}),
     "Heartbeat": MessageSchema(
@@ -135,7 +144,13 @@ def make_generic_handler(
                     validate_message(name, req, schemas)
                 except SchemaError as e:
                     ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-            return fn(req)
+            try:
+                return fn(req)
+            except SchemaError as e:
+                # Contract violations detected INSIDE a handler (e.g. the
+                # RegisterWorker protocol-version check) surface as the same
+                # structured boundary error, not a generic INTERNAL.
+                ctx.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
 
         return handler
 

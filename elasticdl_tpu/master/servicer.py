@@ -14,7 +14,8 @@ Method table (the wire contract):
   ReportTaskResult   {worker_id, task_id, success,
                       metrics?, weight?, model_version?} -> {accepted}
   ReportVersion      {worker_id, model_version}        -> {}
-  RegisterWorker     {worker_id}                       -> membership
+  RegisterWorker     {worker_id, address?, proto?}     -> membership
+                      (proto != PROTOCOL_VERSION -> FAILED_PRECONDITION)
   DeregisterWorker   {worker_id}                       -> {version}
   Heartbeat          {worker_id}                       -> {version}
   GetMembership      {}                                -> membership
@@ -34,7 +35,9 @@ import grpc
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.rpc import (
     MASTER_SCHEMAS,
+    PROTOCOL_VERSION,
     SERVICE_NAME,
+    SchemaError,
     make_generic_handler,
 )
 from elasticdl_tpu.master.evaluation_service import EvaluationService
@@ -332,6 +335,16 @@ class MasterServicer:
             self.evaluation.maybe_trigger(current)
 
     def RegisterWorker(self, req: dict) -> dict:
+        # Wire-version negotiation: a mismatched worker is turned away HERE,
+        # at its first RPC, with an error naming both versions — not N tasks
+        # later with an opaque schema violation.  Absent field = accepted
+        # (pre-versioning peer; proto3 unknown-field stance).
+        proto = req.get("proto")
+        if proto is not None and proto != PROTOCOL_VERSION:
+            raise SchemaError(
+                f"protocol version mismatch: worker speaks v{proto}, "
+                f"master speaks v{PROTOCOL_VERSION} — upgrade the older side"
+            )
         self.rendezvous.register(req["worker_id"], req.get("address", ""))
         self._known_workers.add(req["worker_id"])
         return self.rendezvous.membership()

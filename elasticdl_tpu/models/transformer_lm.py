@@ -63,6 +63,21 @@ def _init_params(
     return params
 
 
+def _block(x, blk, axis, n_heads, compute_dtype):
+    """One pre-norm transformer block (attention + MLP residual)."""
+    b, l, dim = x.shape
+    head_dim = dim // n_heads
+    h = _rms_norm(x, blk["ln1"])
+    qkv = h @ blk["wqkv"].astype(compute_dtype)  # [B, L, 3*dim]
+    q, k, v = jnp.split(qkv.reshape(b, l, 3 * n_heads, head_dim), 3, axis=2)
+    # Blockwise causal attention; K/V ring over the sequence axis.
+    att = ring_attention(q, k, v, axis_name=axis, causal=True)
+    x = x + att.reshape(b, l, dim) @ blk["wo"].astype(compute_dtype)
+    h = _rms_norm(x, blk["ln2"])
+    h = jax.nn.gelu(h @ blk["w1"].astype(compute_dtype))
+    return x + h @ blk["w2"].astype(compute_dtype)
+
+
 def _apply(
     params,
     batch,
@@ -70,12 +85,11 @@ def _apply(
     ctx: ParallelContext = ParallelContext(),
     n_heads: int = 4,
     compute_dtype=jnp.bfloat16,
+    remat: bool = True,
     **_,
 ):
     tokens = batch["tokens"]  # [B, L_local] (sequence-sharded over the axis)
-    b, l = tokens.shape
-    dim = params["tok_emb"].shape[-1]
-    head_dim = dim // n_heads
+    l = tokens.shape[1]
     axis = ctx.axis_name
     # Fail loud on over-long sequences: positions past max_seq would silently
     # CLAMP on the pos_emb gather (same stance as the embedding OOV contract).
@@ -91,17 +105,20 @@ def _apply(
 
     x = params["tok_emb"][tokens] + params["pos_emb"][pos][None]
     x = x.astype(compute_dtype)
+    # Rematerialization (jax.checkpoint) per block in TRAINING: activations
+    # inside a block are recomputed during the backward instead of living in
+    # HBM for the whole forward — peak activation memory drops from
+    # O(n_layers * B * S/n * dim * ~10) to ~one block's worth (+ the residual
+    # stream), the standard FLOPs-for-HBM trade for long sequences.  The
+    # ring-attention ppermutes replay fine under remat (pure collective).
+    # Eval/predict skip it — there is no backward to save memory for.
+    block_fn = functools.partial(
+        _block, axis=axis, n_heads=n_heads, compute_dtype=compute_dtype
+    )
+    if remat and train:
+        block_fn = jax.checkpoint(block_fn)
     for name in sorted(params["blocks"]):
-        blk = params["blocks"][name]
-        h = _rms_norm(x, blk["ln1"])
-        qkv = h @ blk["wqkv"].astype(compute_dtype)  # [B, L, 3*dim]
-        q, k, v = jnp.split(qkv.reshape(b, l, 3 * n_heads, head_dim), 3, axis=2)
-        # Blockwise causal attention; K/V ring over the sequence axis.
-        att = ring_attention(q, k, v, axis_name=axis, causal=True)
-        x = x + att.reshape(b, l, dim) @ blk["wo"].astype(compute_dtype)
-        h = _rms_norm(x, blk["ln2"])
-        h = jax.nn.gelu(h @ blk["w1"].astype(compute_dtype))
-        x = x + h @ blk["w2"].astype(compute_dtype)
+        x = block_fn(x, params["blocks"][name])
     x = _rms_norm(x, params["ln_f"])
     # Weight-tied head; logits in f32 for a stable softmax/CE.
     return (x @ params["tok_emb"].T.astype(compute_dtype)).astype(jnp.float32)
@@ -139,6 +156,7 @@ def model_spec(
     n_layers: int = 2,
     max_seq: int = 4096,
     seq_len: int = 256,
+    remat: bool = True,
 ) -> ModelSpec:
     dtype = jnp.dtype(compute_dtype)
     return ModelSpec(
@@ -151,7 +169,9 @@ def model_spec(
             n_layers=n_layers,
             max_seq=max_seq,
         ),
-        apply=functools.partial(_apply, n_heads=n_heads, compute_dtype=dtype),
+        apply=functools.partial(
+            _apply, n_heads=n_heads, compute_dtype=dtype, remat=remat
+        ),
         loss=_loss,
         metrics=_metrics,
         optimizer=optax.adamw(learning_rate),

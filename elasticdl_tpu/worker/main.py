@@ -20,6 +20,7 @@ from typing import List, Optional
 from elasticdl_tpu.common.config import JobConfig, parse_args
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.platform import apply_platform_env
+from elasticdl_tpu.common.rpc import PROTOCOL_VERSION
 
 apply_platform_env()
 from elasticdl_tpu.data.reader import (
@@ -90,6 +91,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         {
             "worker_id": worker_id,
             "address": distributed.advertised_address() if config.multihost else "",
+            "proto": PROTOCOL_VERSION,
         },
     )
     # Liveness is a background thread, decoupled from the task loop: the

@@ -24,7 +24,7 @@ import numpy as np
 from elasticdl_tpu.common.checkpoint import CheckpointManager
 from elasticdl_tpu.common.config import JobConfig
 from elasticdl_tpu.common.log_utils import get_logger
-from elasticdl_tpu.common.rpc import JsonRpcClient
+from elasticdl_tpu.common.rpc import PROTOCOL_VERSION, JsonRpcClient
 from elasticdl_tpu.data.reader import AbstractDataReader
 from elasticdl_tpu.master.task_dispatcher import (
     TASK_EVALUATION,
@@ -411,7 +411,11 @@ class Worker:
         if membership is None:
             membership = self.master.call(
                 "RegisterWorker",
-                {"worker_id": self.worker_id, "address": self._advertised_address()},
+                {
+                    "worker_id": self.worker_id,
+                    "address": self._advertised_address(),
+                    "proto": PROTOCOL_VERSION,
+                },
             )
         self._apply_membership(membership, initial=True)
         if self.state is None:

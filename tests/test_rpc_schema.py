@@ -87,3 +87,35 @@ def test_malformed_request_fails_at_grpc_boundary():
         assert client.call("JobStatus", {})["finished"] is True
     finally:
         server.stop()
+
+
+def test_protocol_version_negotiation():
+    """A mismatched wire version is rejected at RegisterWorker — the
+    worker's FIRST call — with a structured error naming both versions;
+    matching and absent (pre-versioning) versions register fine."""
+    from elasticdl_tpu.common.rpc import PROTOCOL_VERSION
+
+    servicer = MasterServicer(TaskDispatcher([]))
+    server = MasterServer(servicer, port=0).start()
+    try:
+        client = JsonRpcClient(server.address)
+        client.wait_ready(10)
+        ok = client.call(
+            "RegisterWorker", {"worker_id": "w-new", "proto": PROTOCOL_VERSION}
+        )
+        assert "version" in ok
+        legacy = client.call("RegisterWorker", {"worker_id": "w-legacy"})
+        assert "version" in legacy
+        with pytest.raises(grpc.RpcError) as err:
+            client.call(
+                "RegisterWorker",
+                {"worker_id": "w-old", "proto": PROTOCOL_VERSION + 7},
+            )
+        assert err.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+        assert f"v{PROTOCOL_VERSION + 7}" in err.value.details()
+        assert f"v{PROTOCOL_VERSION}" in err.value.details()
+        # the rejected worker never entered the membership
+        members = client.call("GetMembership", {})["workers"]
+        assert "w-old" not in members and "w-new" in members
+    finally:
+        server.stop()

@@ -125,3 +125,26 @@ def test_over_long_sequence_fails_loud(devices):
     state = tr.init_state(jax.random.key(0))
     with pytest.raises(ValueError, match="max_seq"):
         tr.run_train_step(state, _batch(np.random.default_rng(0)))
+
+
+def test_remat_matches_no_remat(devices):
+    """jax.checkpoint per block (remat=True, the default) changes only WHEN
+    activations are computed, never the values: losses and a full train step
+    match the remat=False lowering across the sequence-sharded mesh."""
+
+    def run(remat):
+        spec = _spec(remat=remat)
+        tr = Trainer(spec, JobConfig(distribution_strategy="AllReduce"),
+                     create_mesh(devices))
+        state = tr.init_state(jax.random.key(0))
+        losses = []
+        for s in range(2):
+            batch = _batch(np.random.default_rng(s))
+            state, m = tr.run_train_step(state, batch)
+            losses.append(float(m["loss"]))
+        return losses, jax.device_get(state.params["blocks"]["b0"]["wqkv"])
+
+    on_losses, on_w = run(True)
+    off_losses, off_w = run(False)
+    np.testing.assert_allclose(on_losses, off_losses, rtol=1e-6)
+    np.testing.assert_allclose(on_w, off_w, rtol=1e-6, atol=1e-7)
