@@ -20,6 +20,56 @@ from elasticdl_tpu.common.log_utils import get_logger
 
 logger = get_logger("metrics")
 
+#: Metric keys with this prefix carry HISTOGRAM vectors, not scalars.  They
+#: flow through every aggregation layer (device psum, worker minibatch sums,
+#: master cross-worker weighted means) unchanged in meaning — histograms are
+#: linear, and the scalars derived from them (AUC) are scale-invariant, so
+#: weighted MEANS aggregate as exactly as sums would.  ``finalize_metrics``
+#: converts them to their scalar at the last step of each pipeline.
+HIST_PREFIX = "__hist__"
+
+#: The one histogram-derived metric so far: ROC AUC from score histograms
+#: (the reference evaluates Criteo/DeepFM on AUC via TF's bucketed streaming
+#: AUC — same construction).
+AUC_POS = HIST_PREFIX + "auc_pos"
+AUC_NEG = HIST_PREFIX + "auc_neg"
+
+
+def auc_from_histograms(pos, neg) -> float:
+    """ROC AUC from per-score-bucket positive/negative counts.
+
+    Rank-statistic identity: AUC = P(score_pos > score_neg) + 0.5 *
+    P(tie).  Bucketed: each positive in bucket b beats every negative in
+    buckets < b and half-ties the negatives in bucket b.  Exact for scores
+    quantized to the bucket grid; O(1/n_bins) bias otherwise — identical to
+    TF's thresholded streaming AUC.  Degenerate sets (no positives or no
+    negatives) return 0.5.
+    """
+    import numpy as np
+
+    pos = np.asarray(pos, np.float64)
+    neg = np.asarray(neg, np.float64)
+    p, n = pos.sum(), neg.sum()
+    if p <= 0 or n <= 0:
+        return 0.5
+    neg_below = np.concatenate([[0.0], np.cumsum(neg)[:-1]])
+    wins = float(np.sum(pos * (neg_below + 0.5 * neg)))
+    # Plain python float: np.float64 leaks would crash json.dumps on the
+    # gRPC JobStatus / metrics-report paths.
+    return float(wins / (p * n))
+
+
+def finalize_metrics(metrics: Dict) -> Dict[str, float]:
+    """Scalar-ize a metrics dict: plain entries -> float, histogram pairs ->
+    their derived scalar ("auc"), raw histogram vectors dropped."""
+    out: Dict[str, float] = {}
+    for k, v in metrics.items():
+        if not k.startswith(HIST_PREFIX):
+            out[k] = float(v)
+    if AUC_POS in metrics and AUC_NEG in metrics:
+        out["auc"] = auc_from_histograms(metrics[AUC_POS], metrics[AUC_NEG])
+    return out
+
 
 class MetricsWriter:
     """Append-only JSONL scalar stream + optional TensorBoard mirror."""

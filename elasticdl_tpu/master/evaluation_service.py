@@ -104,17 +104,26 @@ class EvaluationService:
     # -- metric aggregation --
 
     def report_metrics(self, metrics: Dict[str, float], weight: float) -> None:
-        """Worker reports per-shard metric means with their example count."""
+        """Worker reports per-shard metric means with their example count.
+        Histogram metrics (streaming AUC — lists) accumulate elementwise
+        under the same weighting; histograms are linear, so the weighted
+        mean of per-shard histograms IS the pooled histogram up to a scale
+        the derived AUC is invariant to."""
+        import numpy as np
+
         with self._lock:
             for name, value in metrics.items():
+                value = np.asarray(value, np.float64)
                 self._sums[name] = self._sums.get(name, 0.0) + value * weight
                 self._counts[name] = self._counts.get(name, 0.0) + weight
 
     def _result_locked(self) -> Dict[str, float]:
-        return {
+        from elasticdl_tpu.common.metrics import finalize_metrics
+
+        return finalize_metrics({
             name: self._sums[name] / max(self._counts[name], 1e-12)
             for name in self._sums
-        }
+        })
 
     def latest_metrics(self) -> Dict[str, float]:
         with self._lock:

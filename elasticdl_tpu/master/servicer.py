@@ -249,7 +249,12 @@ class MasterServicer:
             # snapshots the aggregate.
             if success and req.get("metrics"):
                 self.evaluation.report_metrics(
-                    {k: float(v) for k, v in req["metrics"].items()},
+                    # Scalars coerce to float; histogram metrics (streaming
+                    # AUC) arrive as lists and aggregate elementwise.
+                    {
+                        k: v if isinstance(v, (list, tuple)) else float(v)
+                        for k, v in req["metrics"].items()
+                    },
                     float(req.get("weight", 1.0)),
                 )
             accepted = self.evaluation.report_task(task_id, success)

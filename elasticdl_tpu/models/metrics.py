@@ -24,3 +24,44 @@ def masked_mean(values: jax.Array, mask: Optional[jax.Array] = None) -> jax.Arra
         return jnp.mean(values)
     m = mask.astype(jnp.float32)
     return jnp.sum(values * m) / jnp.maximum(jnp.sum(m), 1e-12)
+
+
+#: Score-histogram resolution for streaming AUC.  512 buckets bounds the
+#: binning bias at ~2e-3 worst-case (uniform ties within a bucket count
+#: half) — the same knob as TF's AUC ``num_thresholds``.
+AUC_BINS = 512
+
+
+def auc_histograms(
+    probs: jax.Array,
+    labels: jax.Array,
+    mask: Optional[jax.Array] = None,
+    n_bins: int = AUC_BINS,
+) -> dict:
+    """Per-bucket positive/negative counts of ``probs`` in [0, 1].
+
+    The device-side half of streaming AUC (common/metrics.py
+    ``auc_from_histograms``): histograms are LINEAR, so they survive every
+    aggregation layer — masked minibatch sums, the eval step's
+    psum(mean*count)/total, the worker's per-task weighting, the master's
+    cross-worker weighted mean — and the AUC derived at the end equals the
+    AUC of the pooled predictions (exactly, for scores on the bucket grid;
+    to ~1/n_bins otherwise).  Returns {AUC_POS: [n_bins], AUC_NEG: [n_bins]}
+    metric entries, normalized to MEANS (divided by the real-example count)
+    so they weight-average identically to the scalar metrics around them —
+    AUC is scale-invariant, so the normalization cancels.
+    """
+    from elasticdl_tpu.common.metrics import AUC_NEG, AUC_POS
+
+    probs = probs.astype(jnp.float32).reshape(-1)
+    labels_f = labels.astype(jnp.float32).reshape(-1)
+    m = (
+        jnp.ones_like(probs)
+        if mask is None
+        else mask.astype(jnp.float32).reshape(-1)
+    )
+    idx = jnp.clip((probs * n_bins).astype(jnp.int32), 0, n_bins - 1)
+    pos = jnp.zeros((n_bins,), jnp.float32).at[idx].add(m * labels_f)
+    neg = jnp.zeros((n_bins,), jnp.float32).at[idx].add(m * (1.0 - labels_f))
+    count = jnp.maximum(jnp.sum(m), 1e-12)
+    return {AUC_POS: pos / count, AUC_NEG: neg / count}

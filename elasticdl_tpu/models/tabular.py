@@ -63,7 +63,7 @@ def log_normalize(dense: jax.Array) -> jax.Array:
 def binary_metrics(logits: jax.Array, labels: jax.Array, mask=None) -> dict:
     """Loss/accuracy/calibration for binary CTR-style tasks (mask: eval
     tail padding — see models/metrics.py)."""
-    from elasticdl_tpu.models.metrics import masked_mean
+    from elasticdl_tpu.models.metrics import auc_histograms, masked_mean
 
     prob = jax.nn.sigmoid(logits)
     pred = (prob >= 0.5).astype(jnp.int32)
@@ -77,6 +77,10 @@ def binary_metrics(logits: jax.Array, labels: jax.Array, mask=None) -> dict:
         # mean(prob)/mean(label): ~1.0 when calibrated, a standard CTR sanity metric
         "calibration": masked_mean(prob, mask)
         / jnp.maximum(masked_mean(labels_f, mask), 1e-6),
+        # Streaming ROC AUC (the reference's headline Criteo metric): score
+        # histograms here, the scalar derived at each pipeline's end
+        # (common/metrics.finalize_metrics).
+        **auc_histograms(prob, labels, mask),
     }
 
 

@@ -39,6 +39,7 @@ from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from elasticdl_tpu.common.config import DistributionStrategy, JobConfig
+from elasticdl_tpu.common.metrics import HIST_PREFIX
 from elasticdl_tpu.models.spec import EmbeddingTableSpec, ModelSpec
 from elasticdl_tpu.ops.embedding import (
     ParallelContext,
@@ -711,7 +712,15 @@ def build_train_step(
         loss = lax.psum(loss, axes)
         updates, opt_state = spec.optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
-        metrics = {k: lax.pmean(v, axes) for k, v in spec.metrics(out, batch).items()}
+        # Histogram metrics (streaming AUC, common/metrics.HIST_PREFIX) are
+        # EVAL machinery — per-minibatch training AUC is noise, and the
+        # reference computes AUC only in evaluation — so the train step
+        # drops them before the collective mean.
+        metrics = {
+            k: lax.pmean(v, axes)
+            for k, v in spec.metrics(out, batch).items()
+            if not k.startswith(HIST_PREFIX)
+        }
         metrics["loss"] = loss
         new_state = TrainState(step=state.step + 1, params=params, opt_state=opt_state)
         if host_keys:

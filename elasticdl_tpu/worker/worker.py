@@ -24,6 +24,7 @@ import numpy as np
 from elasticdl_tpu.common.checkpoint import CheckpointManager
 from elasticdl_tpu.common.config import JobConfig
 from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.common.metrics import finalize_metrics
 from elasticdl_tpu.common.rpc import PROTOCOL_VERSION, JsonRpcClient
 from elasticdl_tpu.data.reader import AbstractDataReader
 from elasticdl_tpu.master.task_dispatcher import (
@@ -352,17 +353,17 @@ class Worker:
         # wrap-pad) instead of reporting only the last one's metrics.
         # Accumulate the DEVICE scalars: a float() per step would block and
         # kill async-dispatch pipelining; one transfer at task end suffices.
-        sums: Dict[str, float] = {}
+        sums: Dict[str, Any] = {}
         for metrics in metrics_list:
             for k, v in metrics.items():
                 sums[k] = sums.get(k, 0.0) + v
-        return {
-            k: float(s) / max(len(metrics_list), 1) for k, s in sums.items()
-        }
+        n = max(len(metrics_list), 1)
+        # finalize: scalars -> float, histogram pairs -> their scalar (AUC).
+        return finalize_metrics({k: np.asarray(s) / n for k, s in sums.items()})
 
     def _run_evaluation_task(self, task: Task) -> tuple:
         records = list(self.reader.read_records(task.shard))
-        sums: Dict[str, float] = {}
+        sums: Dict[str, Any] = {}
         total = 0.0
         for chunk, true_count in _minibatches(
             records, self.config.minibatch_size, False
@@ -376,9 +377,17 @@ class Worker:
             ).astype(np.float32)
             metrics = self.trainer.run_eval_step(self.state, batch)
             for k, v in metrics.items():
-                sums[k] = sums.get(k, 0.0) + float(v) * true_count
+                # Histogram metrics (streaming AUC) are vectors; accumulate
+                # with the same count weighting as the scalars.
+                sums[k] = sums.get(k, 0.0) + np.asarray(v, np.float64) * true_count
             total += true_count
-        return {k: s / max(total, 1e-12) for k, s in sums.items()}, total
+        # Report RAW weighted means — including histogram vectors (as JSON
+        # lists) — so the MASTER's cross-worker aggregation stays exact; it
+        # derives the AUC scalar at round end (evaluation_service).
+        means = {k: s / max(total, 1e-12) for k, s in sums.items()}
+        return {
+            k: (v.tolist() if v.ndim else float(v)) for k, v in means.items()
+        }, total
 
     def _run_prediction_task(self, task: Task) -> None:
         records = list(self.reader.read_records(task.shard))

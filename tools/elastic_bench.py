@@ -80,7 +80,7 @@ def main() -> None:
         state, metrics = trainer.train_step(state, trainer.shard_batch(_batch(seed=s)))
     jax.block_until_ready(metrics)
     ckpt.save(int(state.step), jax.device_get(state), wait=True)
-    print(f"[elastic-bench] trained 3 steps on 8 devices, checkpointed",
+    print("[elastic-bench] trained 3 steps on 8 devices, checkpointed",
           file=sys.stderr)
 
     def resize(n_devices, seed):
