@@ -87,8 +87,9 @@ class ModelSpec:
     # Which batch dimension the mesh axis shards: 0 = data parallelism
     # (examples, the default), 1 = sequence/context parallelism (each device
     # holds every example's [S/n] chunk — ring attention territory).  Leaves
-    # with ndim <= batch_shard_dim (e.g. per-example masks under SP) are
-    # replicated.
+    # with ndim <= batch_shard_dim (e.g. per-example masks under SP)
+    # replicate on a 1-D mesh; on hierarchical (dp, ep) meshes they follow
+    # the example dim's dp sharding (trainer._batch_spec_for).
     batch_shard_dim: int = 0
     # Example batch (tiny) for compile checks / shape inference.
     example_batch: Optional[Callable[[int], Batch]] = None

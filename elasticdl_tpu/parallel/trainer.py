@@ -65,6 +65,17 @@ def _process_count(mesh: Mesh) -> int:
     return len({d.process_index for d in mesh.devices.flat})
 
 
+def sp_batch_spec(axes: Tuple[str, ...], d: int) -> P:
+    """PartitionSpec for a sequence-parallel leaf of ndim > d: the sequence
+    dim ``d`` shards over the INNER axis; on hierarchical meshes the example
+    dim additionally shards over the outer axes.  One definition shared by
+    input batch sharding and predict output sharding so the two layouts
+    cannot drift apart."""
+    outer = axes[:-1]
+    lead = ((outer,) + (None,) * (d - 1)) if outer else (None,) * d
+    return P(*lead, axes[-1])
+
+
 def _path_keys(path) -> Tuple[str, ...]:
     keys = []
     for entry in path:
@@ -230,21 +241,20 @@ class Trainer:
     def _adopt_mesh_axes(self, mesh: Mesh) -> None:
         """Axis roles for 1-D and hierarchical meshes.
 
-        The batch shards over EVERY mesh axis; embedding tables shard over
-        the LAST axis only.  On a 1-D ``("dp",)`` mesh the two coincide (the
-        original design).  On a hierarchical ``("dp", "ep")`` mesh
-        (mesh.create_mesh dcn_parallelism > 1) the outer dp axis strides
-        across hosts/slices — its only collective is the grad psum, which
-        tolerates DCN — while the latency-sensitive embedding all-to-all
-        stays on the inner ICI axis.
+        Embedding tables (and the collective lookup / ring attention) always
+        use the LAST axis.  Batch layout by model:
+
+        - data-parallel models (batch_shard_dim=0): the example dim shards
+          over EVERY axis jointly.
+        - sequence-parallel models (batch_shard_dim=1): on a 1-D mesh the
+          sequence dim shards over the single axis (examples replicated); on
+          a hierarchical ``("dp", "ep")`` mesh examples shard over the outer
+          dp axis and the sequence over the inner ICI axis — data
+          parallelism across hosts (DCN sees only the grad psum) with the
+          ring attention's ppermutes confined to ICI within a slice.
         """
         self.batch_axes = tuple(mesh.axis_names)
-        self.axis_name = mesh.axis_names[-1]  # the embedding/table axis
-        if len(self.batch_axes) > 1 and self.spec.batch_shard_dim != 0:
-            raise NotImplementedError(
-                "hierarchical (dp, ep) meshes support data-parallel batches "
-                "only; sequence-parallel models use a 1-D mesh"
-            )
+        self.axis_name = mesh.axis_names[-1]  # embedding/sequence axis
 
     def _make_ctx(self) -> ParallelContext:
         # Resolve "auto" against the MESH's platform (not the default
@@ -327,16 +337,26 @@ class Trainer:
         return jax.tree.map(place, state, shardings)
 
     def _batch_spec_for(self, leaf) -> P:
-        """PartitionSpec for one batch leaf: EVERY mesh axis shards dimension
-        ``spec.batch_shard_dim`` (0 = examples, 1 = sequence); leaves too
-        small to have that dimension (per-example masks under SP) replicate.
-        On a hierarchical mesh the batch dim shards over (dp, ep) jointly —
-        each device still holds B/total examples."""
+        """PartitionSpec for one batch leaf.
+
+        Data-parallel models (batch_shard_dim=0): the example dim shards
+        over EVERY mesh axis jointly — each device holds B/total examples.
+
+        Sequence-parallel models (batch_shard_dim=1): the sequence dim
+        shards over the inner axis; on hierarchical meshes the example dim
+        additionally shards over the outer (dp) axes (sp_batch_spec).
+        Leaves WITHOUT a sequence dim (per-example masks) replicate on a
+        1-D mesh but must follow the example-dim sharding on hierarchical
+        meshes — a replicated [B] mask against dp-sharded [B/dp, S/ep]
+        tokens would weight the wrong examples."""
         d = self.spec.batch_shard_dim
         if d == 0:
             return P(self.batch_axes)
         if getattr(leaf, "ndim", 0) > d:
-            return P(*([None] * d), self.batch_axes)
+            return sp_batch_spec(self.batch_axes, d)
+        outer = self.batch_axes[:-1]
+        if outer and getattr(leaf, "ndim", 0) >= 1:
+            return P(outer)
         return P()
 
     def batch_specs(self, batch: Any):
@@ -352,14 +372,20 @@ class Trainer:
         contributes its own slice via
         ``jax.make_array_from_process_local_data`` (SURVEY.md §3.5).
         """
-        n = self.mesh.devices.size
-        d = self.spec.batch_shard_dim
         for leaf in jax.tree.leaves(batch):
-            if getattr(leaf, "ndim", 0) > d and leaf.shape[d] % n != 0:
-                raise ValueError(
-                    f"batch dimension {d} of size {leaf.shape[d]} not "
-                    f"divisible by mesh size {n}"
-                )
+            spec = self._batch_spec_for(leaf)
+            for dim, part in enumerate(spec):
+                if part is None:
+                    continue
+                names = part if isinstance(part, tuple) else (part,)
+                k = 1
+                for nm in names:
+                    k *= self.mesh.shape[nm]
+                if leaf.shape[dim] % k != 0:
+                    raise ValueError(
+                        f"batch dimension {dim} of size {leaf.shape[dim]} "
+                        f"not divisible by its mesh axes {names} (size {k})"
+                    )
         shardings = jax.tree.map(
             lambda x: NamedSharding(self.mesh, self._batch_spec_for(x)), batch
         )
@@ -760,13 +786,15 @@ def build_predict_step(
 
     d = spec.batch_shard_dim
     axes = tuple(batch_axes) if batch_axes else (axis,)
+    # Per-example outputs mirror the input batch layout: DP outputs shard
+    # the example dim over every axis; SP outputs use the shared
+    # sp_batch_spec so input and output layouts cannot drift apart.
+    out_spec = P(axes) if d == 0 else sp_batch_spec(axes, d)
     mapped = shard_map(
         local_predict,
         mesh=mesh,
         in_specs=(state_specs, batch_specs if batch_specs is not None else P(axis)),
-        # Per-example outputs shard on the model's batch dimension (the
-        # sequence dim for SP models).
-        out_specs=P(*([None] * d), axes),
+        out_specs=out_spec,
         check_vma=False,
     )
     return jax.jit(mapped)

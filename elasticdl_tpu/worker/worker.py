@@ -211,18 +211,17 @@ class Worker:
             )
         n_dev = self._mesh_size(world)
         dcn = getattr(self.config, "dcn_data_parallelism", 1)
-        if dcn > 1 and (n_dev % dcn != 0 or self.spec.batch_shard_dim != 0):
+        if dcn > 1 and n_dev % dcn != 0:
             # Training availability beats layout: an elastic resize can land
             # on a device count the configured hierarchy no longer divides
-            # (dcn=2 after shrinking to 3 hosts), and sequence-parallel
-            # models only support 1-D meshes (trainer._adopt_mesh_axes) —
-            # both fall back to the flat mesh instead of crash-looping the
-            # relaunch budget away.  Checked HERE (not via exception) so a
-            # genuine too-few-devices ValueError below keeps its own story.
+            # (dcn=2 after shrinking to 3 hosts) — fall back to the flat
+            # mesh instead of crash-looping the relaunch budget away.
+            # Checked HERE (not via exception) so a genuine too-few-devices
+            # ValueError below keeps its own story.
             logger.warning(
-                "dcn_data_parallelism=%d unusable (devices=%d, "
-                "batch_shard_dim=%d); falling back to a flat 1-D mesh",
-                dcn, n_dev, self.spec.batch_shard_dim,
+                "dcn_data_parallelism=%d does not divide %d devices; "
+                "falling back to a flat 1-D mesh",
+                dcn, n_dev,
             )
             dcn = 1
         mesh = create_mesh(self._pool, num_devices=n_dev, dcn_parallelism=dcn)

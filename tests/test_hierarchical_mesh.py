@@ -133,8 +133,16 @@ def test_eval_and_predict_match_flat(devices):
     hier = Trainer(spec, cfg, create_mesh(devices, dcn_parallelism=2))
     fs = flat.init_state(jax.random.key(0))
     hs = hier.init_state(jax.random.key(0))
-    fm = {k: float(v) for k, v in flat.eval_step(fs, flat.shard_batch(dict(batch))).items()}
-    hm = {k: float(v) for k, v in hier.eval_step(hs, hier.shard_batch(dict(batch))).items()}
+    from elasticdl_tpu.common.metrics import finalize_metrics
+
+    fm = finalize_metrics(
+        {k: np.asarray(v) for k, v in
+         flat.eval_step(fs, flat.shard_batch(dict(batch))).items()}
+    )
+    hm = finalize_metrics(
+        {k: np.asarray(v) for k, v in
+         hier.eval_step(hs, hier.shard_batch(dict(batch))).items()}
+    )
     assert fm.keys() == hm.keys()
     for k in fm:
         np.testing.assert_allclose(hm[k], fm[k], rtol=1e-5)
@@ -159,14 +167,19 @@ def test_masked_eval_tail_exact_on_hierarchical(devices):
     )
     hier = Trainer(spec, cfg, create_mesh(devices, dcn_parallelism=2))
     hs = hier.init_state(jax.random.key(0))
-    got = {
-        k: float(v)
-        for k, v in hier.eval_step(hs, hier.shard_batch(padded)).items()
-    }
+    from elasticdl_tpu.common.metrics import finalize_metrics
+
+    got = finalize_metrics(
+        {k: np.asarray(v) for k, v in
+         hier.eval_step(hs, hier.shard_batch(padded)).items()}
+    )
     # Ground truth: unsharded forward over the REAL rows only.
     params = jax.device_get(hs).params
     out = spec.apply(params, real, train=False)
-    want = {k: float(v) for k, v in spec.metrics(jnp.asarray(out), real).items()}
+    want = finalize_metrics(
+        {k: np.asarray(v) for k, v in
+         spec.metrics(jnp.asarray(out), real).items()}
+    )
     for k in want:
         np.testing.assert_allclose(got[k], want[k], rtol=1e-5, atol=1e-6)
 
@@ -200,16 +213,53 @@ def test_host_tier_on_hierarchical_mesh(devices):
     )
 
 
-def test_sp_model_rejects_hierarchical_mesh(devices):
+def test_hierarchical_sequence_parallelism(devices):
+    """SP on a (dp, ep) mesh: examples shard across the outer axis, the
+    sequence (and ring attention) across the inner ICI axis.  Losses and a
+    train step match the flat 1-D sequence-parallel mesh; predictions come
+    back with the full global shape and match too."""
     spec = load_model_spec(
         "elasticdl_tpu.models", "transformer_lm.model_spec",
-        vocab=128, dim=32, n_layers=1, n_heads=2, max_seq=64, seq_len=32,
+        vocab=128, dim=32, n_layers=2, n_heads=2, max_seq=64, seq_len=64,
         compute_dtype="float32",
     )
     assert spec.batch_shard_dim == 1
-    with pytest.raises(NotImplementedError, match="1-D mesh"):
-        Trainer(
-            spec,
-            JobConfig(distribution_strategy=DistributionStrategy.ALLREDUCE),
-            create_mesh(devices, dcn_parallelism=2),
-        )
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, 128, (4, 65)).astype(np.int32)
+    batch = {"tokens": toks[:, :-1], "labels": toks[:, 1:]}
+    cfg = JobConfig(distribution_strategy=DistributionStrategy.ALLREDUCE)
+
+    def run(mesh):
+        tr = Trainer(spec, cfg, mesh)
+        st = tr.init_state(jax.random.key(0))
+        st, m = tr.train_step(st, tr.shard_batch(dict(batch)))
+        pred = np.asarray(tr.predict_step(st, tr.shard_batch(dict(batch))))
+        return float(m["loss"]), pred
+
+    flat_loss, flat_pred = run(create_mesh(devices))
+    hier_loss, hier_pred = run(create_mesh(devices, dcn_parallelism=2))
+    np.testing.assert_allclose(hier_loss, flat_loss, rtol=1e-5)
+    assert hier_pred.shape == (4, 64, 128)
+    np.testing.assert_allclose(hier_pred, flat_pred, rtol=1e-4, atol=1e-5)
+
+    # Per-example (mask-shaped) leaves follow the example dim's dp sharding
+    # on hierarchical meshes; they replicate on 1-D SP meshes as before.
+    from jax.sharding import PartitionSpec as P
+
+    hier_tr = Trainer(spec, cfg, create_mesh(devices, dcn_parallelism=2))
+    flat_tr = Trainer(spec, cfg, create_mesh(devices))
+    mask = np.ones((4,), np.float32)
+    assert hier_tr._batch_spec_for(mask) == P(("dp",))
+    assert flat_tr._batch_spec_for(mask) == P()
+
+    # Sequence not divisible by the INNER axis (4) fails loud; batch not
+    # divisible by the outer axis too.
+    tr = Trainer(spec, cfg, create_mesh(devices, dcn_parallelism=2))
+    bad_seq = {"tokens": np.zeros((4, 62), np.int32),
+               "labels": np.zeros((4, 62), np.int32)}
+    with pytest.raises(ValueError, match="dimension 1"):
+        tr.shard_batch(bad_seq)
+    bad_b = {"tokens": np.zeros((3, 64), np.int32),
+             "labels": np.zeros((3, 64), np.int32)}
+    with pytest.raises(ValueError, match="dimension 0"):
+        tr.shard_batch(bad_b)
