@@ -11,3 +11,8 @@ from elasticdl_tpu.ps.host_store import (  # noqa: F401
     HostEmbeddingStore,
     native_lib_available,
 )
+from elasticdl_tpu.ps.service import (  # noqa: F401
+    PSClient,
+    PSServer,
+    RemoteEmbeddingStore,
+)

@@ -184,3 +184,38 @@ def test_master_round_aggregates_auc(tmp_path):
     np.testing.assert_allclose(
         result["auc"], _exact_auc(scores, labels), rtol=1e-6
     )
+
+
+def test_job_status_with_auc_serializes_over_grpc(tmp_path):
+    """JobStatus carries eval_metrics with the derived AUC; the value must
+    be a plain python float or json.dumps on the gRPC wire dies (np.float64
+    leaked here once — caught by the end-to-end drive)."""
+    import json
+
+    from elasticdl_tpu.common.rpc import JsonRpcClient
+    from elasticdl_tpu.data.reader import Shard
+    from elasticdl_tpu.master.evaluation_service import EvaluationService
+    from elasticdl_tpu.master.servicer import MasterServer, MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+
+    svc = EvaluationService([Shard(name="a", start=0, end=8)], evaluation_steps=1)
+    servicer = MasterServicer(TaskDispatcher([]), evaluation=svc)
+    server = MasterServer(servicer, port=0).start()
+    try:
+        svc.trigger(model_version=1)
+        task = svc.get_task("w")
+        h = auc_histograms(
+            jnp.asarray(_quantize(np.array([0.9, 0.2]))), jnp.asarray([1, 0])
+        )
+        client = JsonRpcClient(server.address)
+        client.wait_ready(10)
+        client.call("ReportTaskResult", {
+            "worker_id": "w", "task_id": task.task_id, "success": True,
+            "task_type": "evaluation", "weight": 2.0,
+            "metrics": {k: np.asarray(v).tolist() for k, v in h.items()},
+        })
+        status = client.call("JobStatus", {})  # round-trips json.dumps
+        assert status["eval_metrics"]["auc"] == 1.0
+        json.dumps(status)  # and the local dict is plain-serializable too
+    finally:
+        server.stop()

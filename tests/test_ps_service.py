@@ -13,10 +13,10 @@ import pytest
 
 from elasticdl_tpu.common.config import DistributionStrategy, JobConfig
 from elasticdl_tpu.models.spec import HostTableIO
+# Canonical public import path for the service tier classes:
+from elasticdl_tpu.ps import PSClient, PSServer, RemoteEmbeddingStore  # noqa: F401
 from elasticdl_tpu.ps.service import (
     PSFrameError,
-    PSServer,
-    RemoteEmbeddingStore,
     decode_frame,
     encode_frame,
     parse_ps_addresses,
