@@ -194,10 +194,11 @@ class Worker:
                 try:
                     step = int(self.state.step)
                     self._ckpt.save(step, jax.device_get(self.state), wait=True)
-                    # Tell the master: the relaunched processes learn of the
-                    # snapshot via GetCheckpoint and resume from it instead
-                    # of re-training from the last PERIODIC checkpoint (or
-                    # scratch).
+                    # Relaunched processes restore from the LOCAL checkpoint
+                    # directory at startup (run()'s newest-restorable walk);
+                    # this snapshot makes the resume point the pre-restart
+                    # step instead of the last PERIODIC checkpoint.  The
+                    # report is observability (JobStatus / metrics stream).
                     self.master.call(
                         "ReportCheckpoint",
                         {"path": self._ckpt.directory, "step": step},
@@ -428,34 +429,55 @@ class Worker:
         self._apply_membership(membership, initial=True)
         if self.state is None:
             self.state = self.trainer.init_state(jax.random.key(0))
-            # Elastic re-join: adopt the job's latest snapshot if one exists.
-            ckpt_info = self.master.call("GetCheckpoint", {})
-            if ckpt_info.get("path") and self._ckpt is not None:
-                # Walk retained steps newest-first; adopt a step only when
-                # BOTH halves restore (a torn pair — dense committed but the
-                # host snapshot missing/truncated after a crash — would
-                # silently pair trained dense layers with re-initialized
-                # embeddings).  An older intact step beats starting over.
-                steps = self._ckpt.all_steps()
-                for step in steps:
-                    try:
-                        restored = self._ckpt.restore(self.state, step=step)
-                        self.trainer.restore_host_stores(
-                            self._ckpt.directory, step
+            # Adopt the newest restorable snapshot from the LOCAL checkpoint
+            # directory.  Deliberately NOT gated on the master's
+            # GetCheckpoint: a fresh master (standalone evaluation/
+            # prediction job over a trained checkpoint, or a master restart)
+            # has no reported checkpoint yet, and gating on it made such
+            # jobs silently score freshly-initialized weights.
+            #
+            # Walk retained steps newest-first; adopt a step only when BOTH
+            # halves restore (a torn pair — dense committed but the host
+            # snapshot missing/truncated after a crash — would silently pair
+            # trained dense layers with re-initialized embeddings).  An
+            # older intact step beats starting over.
+            steps = self._ckpt.all_steps() if self._ckpt is not None else []
+            restored_step = None
+            for step in steps:
+                try:
+                    restored = self._ckpt.restore(self.state, step=step)
+                    self.trainer.restore_host_stores(
+                        self._ckpt.directory, step
+                    )
+                    self.state = restored
+                    restored_step = step
+                    logger.info("joined from checkpoint step %d", step)
+                    break
+                except FileNotFoundError as e:
+                    logger.warning(
+                        "checkpoint step %d torn (%s); trying older", step, e
+                    )
+            if restored_step is None:
+                if self.config.job_type in ("evaluation", "prediction"):
+                    if self._ckpt is not None:
+                        # Fail-loud: scoring random weights is silent garbage.
+                        raise RuntimeError(
+                            f"{self.config.job_type} job found no restorable "
+                            f"checkpoint under {self._ckpt.directory} "
+                            f"(steps seen: {steps}); refusing to score "
+                            "freshly initialized weights"
                         )
-                        self.state = restored
-                        logger.info("joined from checkpoint step %d", step)
-                        break
-                    except FileNotFoundError as e:
-                        logger.warning(
-                            "checkpoint step %d torn (%s); trying older", step, e
-                        )
-                else:
-                    if steps:
-                        logger.error(
-                            "every retained checkpoint step %s was torn; "
-                            "training from freshly initialized state", steps,
-                        )
+                    # No --checkpoint_dir at all: legitimate for smoke tests,
+                    # a misconfiguration in production — say so loudly.
+                    logger.warning(
+                        "%s job has no --checkpoint_dir: scoring FRESHLY "
+                        "INITIALIZED weights", self.config.job_type,
+                    )
+                if steps:
+                    logger.error(
+                        "every retained checkpoint step %s was torn; "
+                        "training from freshly initialized state", steps,
+                    )
 
         tasks_done = 0
         while True:

@@ -252,7 +252,9 @@ def _spawn_worker(worker_id: str, config: JobConfig, log_dir) -> subprocess.Pope
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
     env.pop("PALLAS_AXON_POOL_IPS", None)  # never grab the real TPU tunnel
-    log = open(os.path.join(log_dir, f"{worker_id}.log"), "w")
+    # APPEND so relaunched incarnations never erase earlier output
+    # (full-log assertions must see every incarnation).
+    log = open(os.path.join(log_dir, f"{worker_id}.log"), "a")
     return subprocess.Popen(
         [sys.executable, "-m", "elasticdl_tpu.worker.main"],
         env=env, stdout=log, stderr=subprocess.STDOUT, cwd="/root/repo",
@@ -507,7 +509,7 @@ def test_two_process_distributed_train_kill_resume(tmp_path):
         server.stop()
 
 
-def _supervise(procs, spawn, servicer, cond, deadline_s, log_tail,
+def _supervise(procs, spawn, cond, deadline_s, log_tail,
                max_relaunch=8):
     """Shared supervision loop: emulate the PodManager by relaunching
     membership-driven exits (RESTART_EXIT_CODE / jax.distributed runtime
@@ -609,12 +611,13 @@ def test_two_process_hierarchical_mesh_trains(tmp_path):
             return done >= done_floor["at2"] + 4
 
         _supervise(
-            procs, lambda w: _spawn_worker(w, config, tmp_path), servicer,
+            procs, lambda w: _spawn_worker(w, config, tmp_path),
             lockstep_progress, deadline_s=300, log_tail=_log_tail,
         )
-        # The hierarchical mesh really ran: search the WHOLE log (the
-        # warning fires once at startup and would scroll out of a tail).
-        for w in list(procs):
+        # The hierarchical mesh really ran: search the WHOLE log of BOTH
+        # workers, every incarnation (append-mode logs; a retired rc=0
+        # worker must be checked too).
+        for w in ("w-a", "w-b"):
             assert "falling back to a flat 1-D mesh" not in _full_log(w)
     finally:
         stop.set()

@@ -147,3 +147,72 @@ def test_partial_tail_batch(tmp_path, devices):
     result = worker.run()
     assert result["tasks_done"] == 1
     assert result["step"] == 2
+
+
+def test_standalone_eval_job_restores_local_checkpoint(tmp_path, devices):
+    """A FRESH master (standalone evaluation job) has no reported checkpoint,
+    but the worker must still restore from the local checkpoint directory —
+    gating on the master's GetCheckpoint made such jobs silently score
+    freshly-initialized weights."""
+    from elasticdl_tpu.master.task_dispatcher import TASK_EVALUATION
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    config, servicer, reader, _, spec = _mnist_job(
+        tmp_path, checkpoint_dir=ckpt_dir, checkpoint_steps=2, num_epochs=1
+    )
+    worker = Worker(
+        config, DirectMasterProxy(servicer), reader,
+        worker_id="w0", spec=spec, devices=devices,
+    )
+    assert worker.run()["step"] == 6
+
+    # Standalone eval job: brand-new master, NOTHING reported to it.
+    val = str(tmp_path / "standalone_val.rio")
+    generate("mnist", val, 32)
+    eval_config = JobConfig(
+        model_def="mnist.model_spec",
+        job_type="evaluation",
+        validation_data=val,
+        minibatch_size=16,
+        checkpoint_dir=ckpt_dir,
+    )
+    eval_reader = create_data_reader(val)
+    dispatcher = TaskDispatcher(
+        eval_reader.create_shards(16), task_type=TASK_EVALUATION
+    )
+    eval_servicer = MasterServicer(dispatcher)
+    assert eval_servicer.GetCheckpoint({}).get("path") is None  # fresh master
+    w2 = Worker(
+        eval_config, DirectMasterProxy(eval_servicer), eval_reader,
+        worker_id="w0", spec=spec, devices=devices,
+    )
+    result = w2.run()
+    assert result["step"] == 6  # trained weights adopted, not fresh init
+
+
+def test_eval_job_without_restorable_checkpoint_fails_loud(tmp_path, devices):
+    """Evaluation with a checkpoint_dir that holds nothing restorable must
+    refuse to run — scoring random weights would be silent garbage."""
+    from elasticdl_tpu.master.task_dispatcher import TASK_EVALUATION
+
+    val = str(tmp_path / "val.rio")
+    generate("mnist", val, 32)
+    config = JobConfig(
+        model_def="mnist.model_spec",
+        job_type="evaluation",
+        validation_data=val,
+        minibatch_size=16,
+        checkpoint_dir=str(tmp_path / "empty_ckpt"),
+    )
+    reader = create_data_reader(val)
+    dispatcher = TaskDispatcher(
+        reader.create_shards(16), task_type=TASK_EVALUATION
+    )
+    servicer = MasterServicer(dispatcher)
+    spec = load_model_spec("elasticdl_tpu.models", "mnist.model_spec", **MNIST_TINY)
+    worker = Worker(
+        config, DirectMasterProxy(servicer), reader,
+        worker_id="w0", spec=spec, devices=devices,
+    )
+    with pytest.raises(RuntimeError, match="no restorable checkpoint"):
+        worker.run()
