@@ -75,6 +75,11 @@ PS_METHODS: Dict[str, Dict[str, tuple]] = {
 
 _HEADER = struct.Struct("<I")  # u32 header length prefix
 
+#: gRPC message cap for BOTH PSServer and PSClient — one constant so the two
+#: sides cannot drift into the asymmetric-cap RESOURCE_EXHAUSTED failure
+#: (a production push is ~8.5 MB of frame, over gRPC's 4 MB default).
+GRPC_MAX_MESSAGE_BYTES = 256 << 20
+
 
 class PSFrameError(ValueError):
     """A frame violated the PS wire contract (boundary error, never a
@@ -196,7 +201,18 @@ class PSServer:
             for key, io in table_specs.items()
         }
         self._lock = threading.Lock()  # serialize save/load vs pull/push
-        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers))
+        # Message-size limits must cover production batches: a full 8192x26
+        # dim-8 push is ~8.5 MB of frame, over gRPC's 4 MB default — the
+        # server AND the client (PSClient) both raise the cap, or a
+        # realistic batch dies with RESOURCE_EXHAUSTED (found by
+        # tools/ps_bench.py at exactly the flagship batch shape).
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers),
+            options=[
+                ("grpc.max_send_message_length", GRPC_MAX_MESSAGE_BYTES),
+                ("grpc.max_receive_message_length", GRPC_MAX_MESSAGE_BYTES),
+            ],
+        )
         self._server.add_generic_rpc_handlers((self._make_handler(),))
         self.port = self._server.add_insecure_port(f"[::]:{port}")
         # grpc reports a lost bind as port 0.  Fail LOUDLY when a specific
@@ -404,8 +420,8 @@ class PSClient:
         self._channel = grpc.insecure_channel(
             address,
             options=[
-                ("grpc.max_send_message_length", 256 << 20),
-                ("grpc.max_receive_message_length", 256 << 20),
+                ("grpc.max_send_message_length", GRPC_MAX_MESSAGE_BYTES),
+                ("grpc.max_receive_message_length", GRPC_MAX_MESSAGE_BYTES),
             ],
         )
         self._stubs: Dict[str, Any] = {}

@@ -245,6 +245,9 @@ def _free_port() -> int:
     return port
 
 
+_incarnation = {}  # worker_id -> launch count (per-incarnation log files)
+
+
 def _spawn_worker(worker_id: str, config: JobConfig, log_dir) -> subprocess.Popen:
     env = dict(os.environ)
     env.update(config.to_env())
@@ -252,13 +255,34 @@ def _spawn_worker(worker_id: str, config: JobConfig, log_dir) -> subprocess.Pope
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
     env.pop("PALLAS_AXON_POOL_IPS", None)  # never grab the real TPU tunnel
-    # APPEND so relaunched incarnations never erase earlier output
-    # (full-log assertions must see every incarnation).
-    log = open(os.path.join(log_dir, f"{worker_id}.log"), "a")
+    # One log file PER INCARNATION: tail checks (fatal-marker classification)
+    # must see only the CURRENT incarnation — a stale marker from a previous
+    # life would misclassify a fresh crash as a relaunchable fatal — while
+    # whole-run assertions read every incarnation's file.
+    n = _incarnation.get(worker_id, 0)
+    _incarnation[worker_id] = n + 1
+    log = open(os.path.join(log_dir, f"{worker_id}.log.{n}"), "w")
     return subprocess.Popen(
         [sys.executable, "-m", "elasticdl_tpu.worker.main"],
         env=env, stdout=log, stderr=subprocess.STDOUT, cwd="/root/repo",
     )
+
+
+def _latest_log(log_dir, worker_id: str) -> str:
+    """The CURRENT incarnation's full output."""
+    n = _incarnation.get(worker_id, 1) - 1
+    path = os.path.join(log_dir, f"{worker_id}.log.{n}")
+    return open(path).read() if os.path.exists(path) else ""
+
+
+def _all_logs(log_dir, worker_id: str) -> str:
+    """Every incarnation's output, concatenated launch order."""
+    out = []
+    for n in range(_incarnation.get(worker_id, 0)):
+        path = os.path.join(log_dir, f"{worker_id}.log.{n}")
+        if os.path.exists(path):
+            out.append(open(path).read())
+    return "".join(out)
 
 
 @pytest.mark.slow
@@ -305,7 +329,7 @@ def test_real_process_scale_4_8_4(tmp_path):
     procs: dict = {}
 
     def _log_tail(w):
-        return open(tmp_path / f"{w}.log").read()[-3000:]
+        return _latest_log(tmp_path, w)[-3000:]
 
     def supervise_until(cond, deadline_s):
         deadline = time.time() + deadline_s
@@ -412,7 +436,7 @@ def test_two_process_distributed_train_kill_resume(tmp_path):
     relaunches = {"count": 0}
 
     def _log_tail(w):
-        return open(tmp_path / f"{w}.log").read()[-3000:]
+        return _latest_log(tmp_path, w)[-3000:]
 
     def supervise_until(cond, deadline_s, max_relaunch=8):
         """Emulate the PodManager: relaunch membership-driven exits — rc=3
@@ -588,10 +612,10 @@ def test_two_process_hierarchical_mesh_trains(tmp_path):
     procs = {}
 
     def _log_tail(w):
-        return open(tmp_path / f"{w}.log").read()[-3000:]
+        return _latest_log(tmp_path, w)[-3000:]
 
     def _full_log(w):
-        return open(tmp_path / f"{w}.log").read()
+        return _all_logs(tmp_path, w)
 
     try:
         procs.update(

@@ -1,0 +1,83 @@
+"""Measure PS service tier throughput: pull/push rows/sec over localhost
+gRPC, single shard and sharded fleets.
+
+The host tier exists for tables too large for HBM; its practical ceiling is
+the RPC path (binary frames — ps/service.py), not the C++ store (the local
+store sustains tens of millions of rows/sec).  This tool quantifies the gap
+so capacity planning ("can the PS fleet feed a step every N ms?") has a
+number, the same way docs/perf.md quantifies the mesh tier.
+
+Usage: python tools/ps_bench.py [--rows 212992] [--dim 8] [--iters 20]
+                                [--shards 1,2,4]
+Prints one JSON line per fleet size:
+  {"shards": n, "pull_rows_per_s": ..., "push_rows_per_s": ...,
+   "pull_ms": ..., "push_ms": ...}
+
+(212992 rows of dim 8 is exactly the flagship DeepFM step's id volume —
+8192 examples x 26 features.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+from elasticdl_tpu.models.spec import HostTableIO
+from elasticdl_tpu.ps.service import PSServer, RemoteEmbeddingStore
+
+
+def bench_fleet(n_shards: int, rows: int, dim: int, iters: int) -> dict:
+    io = HostTableIO(ids_fn=lambda b: b, dim=dim, optimizer="adagrad")
+    servers = [
+        PSServer({"t": io}, shard=s, num_shards=n_shards).start()
+        for s in range(n_shards)
+    ]
+    store = RemoteEmbeddingStore("t", dim, [s.address for s in servers])
+    store.wait_ready()
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 1 << 30, size=(rows,)).astype(np.int64)
+    grads = rng.randn(rows, dim).astype(np.float32)
+    try:
+        store.pull(ids)  # materialize rows once (lazy init off the clock)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            store.pull(ids)
+        pull_s = (time.perf_counter() - t0) / iters
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            store.push_grad(ids, grads)
+        push_s = (time.perf_counter() - t0) / iters
+    finally:
+        store.close()
+        for s in servers:
+            s.stop()
+    return {
+        "shards": n_shards,
+        "pull_rows_per_s": round(rows / pull_s),
+        "push_rows_per_s": round(rows / push_s),
+        "pull_ms": round(pull_s * 1e3, 2),
+        "push_ms": round(push_s * 1e3, 2),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=8192 * 26)
+    ap.add_argument("--dim", type=int, default=8)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--shards", default="1,2,4")
+    args = ap.parse_args()
+    for n in (int(s) for s in args.shards.split(",")):
+        result = bench_fleet(n, args.rows, args.dim, args.iters)
+        print(json.dumps(result), flush=True)
+        print(f"  {n} shard(s): pull {result['pull_ms']} ms, "
+              f"push {result['push_ms']} ms for {args.rows} rows",
+              file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
