@@ -245,7 +245,7 @@ def _free_port() -> int:
     return port
 
 
-_incarnation = {}  # worker_id -> launch count (per-incarnation log files)
+_incarnation = {}  # (log_dir, worker_id) -> launch count (per-test isolation)
 
 
 def _spawn_worker(worker_id: str, config: JobConfig, log_dir) -> subprocess.Popen:
@@ -259,8 +259,9 @@ def _spawn_worker(worker_id: str, config: JobConfig, log_dir) -> subprocess.Pope
     # must see only the CURRENT incarnation — a stale marker from a previous
     # life would misclassify a fresh crash as a relaunchable fatal — while
     # whole-run assertions read every incarnation's file.
-    n = _incarnation.get(worker_id, 0)
-    _incarnation[worker_id] = n + 1
+    key = (str(log_dir), worker_id)
+    n = _incarnation.get(key, 0)
+    _incarnation[key] = n + 1
     log = open(os.path.join(log_dir, f"{worker_id}.log.{n}"), "w")
     return subprocess.Popen(
         [sys.executable, "-m", "elasticdl_tpu.worker.main"],
@@ -270,7 +271,7 @@ def _spawn_worker(worker_id: str, config: JobConfig, log_dir) -> subprocess.Pope
 
 def _latest_log(log_dir, worker_id: str) -> str:
     """The CURRENT incarnation's full output."""
-    n = _incarnation.get(worker_id, 1) - 1
+    n = _incarnation.get((str(log_dir), worker_id), 1) - 1
     path = os.path.join(log_dir, f"{worker_id}.log.{n}")
     return open(path).read() if os.path.exists(path) else ""
 
@@ -278,7 +279,7 @@ def _latest_log(log_dir, worker_id: str) -> str:
 def _all_logs(log_dir, worker_id: str) -> str:
     """Every incarnation's output, concatenated launch order."""
     out = []
-    for n in range(_incarnation.get(worker_id, 0)):
+    for n in range(_incarnation.get((str(log_dir), worker_id), 0)):
         path = os.path.join(log_dir, f"{worker_id}.log.{n}")
         if os.path.exists(path):
             out.append(open(path).read())
