@@ -92,11 +92,28 @@ class Master:
         reader = create_data_reader(
             primary, config.parsed_data_reader_params()
         )
+        shards = reader.create_shards(records_per_task)
+        # Master-restart resume (SURVEY §5 "restore on master restart"): a
+        # training job with a checkpoint_dir persists its task-progress
+        # watermark (epoch + done shards); a restarted master skips finished
+        # work instead of re-running the epoch from the top — model state
+        # already resumes via the workers' checkpoint restore, so together a
+        # master restart loses at most the in-flight shards.  Persisted
+        # state is ignored when the job shape changed (different data/epoch
+        # config — the watermark would skip the wrong shards).
+        self._progress_path = (
+            os.path.join(config.checkpoint_dir, "job_progress.json")
+            if config.job_type == "training" and config.checkpoint_dir
+            else ""
+        )
+        self._last_progress: Optional[str] = None
+        resume = self._load_progress(len(shards), config.num_epochs)
         self.dispatcher = TaskDispatcher(
-            reader.create_shards(records_per_task),
+            shards,
             num_epochs=config.num_epochs if config.job_type == "training" else 1,
             task_type=task_type,
             task_timeout_s=config.task_timeout_s,
+            resume=resume,
         )
         self.evaluation: Optional[EvaluationService] = None
         if config.job_type == "training" and config.validation_data:
@@ -129,6 +146,10 @@ class Master:
             # reference's semantics); >0 means interval-based rounds.
             epoch_end_eval=config.evaluation_steps == 0,
         )
+        # Task watermark persists when a model checkpoint is REPORTED — the
+        # only moment the (model state, data progress) pair is consistent on
+        # disk (see _persist_progress).
+        self.servicer.set_checkpoint_callback(self._persist_progress)
         self.server = MasterServer(
             self.servicer, port=port, advertise_host=self._advertise_host(config)
         )
@@ -186,6 +207,60 @@ class Master:
             config,
         )
         self.pod_manager.add_listener(self._on_pod_event)
+
+    def _load_progress(self, num_shards: int, num_epochs: int):
+        if not self._progress_path or not os.path.exists(self._progress_path):
+            return None
+        import json
+
+        try:
+            with open(self._progress_path) as f:
+                progress = json.load(f)
+        except (OSError, ValueError):
+            logger.warning("unreadable job progress file; starting fresh")
+            return None
+        if (
+            progress.get("num_shards") != num_shards
+            or progress.get("num_epochs") != num_epochs
+        ):
+            logger.warning(
+                "job progress watermark is for a different job shape "
+                "(%s shards x %s epochs vs %d x %d); starting fresh",
+                progress.get("num_shards"), progress.get("num_epochs"),
+                num_shards, num_epochs,
+            )
+            return None
+        logger.info(
+            "resuming task progress: epoch %s, %s shards done in it, "
+            "%s tasks done total",
+            progress.get("epoch"), len(progress.get("done_shards", [])),
+            progress.get("done_count"),
+        )
+        return progress
+
+    def _persist_progress(self, _step: int = 0) -> None:
+        """Atomically write the dispatcher watermark when it changed.
+
+        Called from the servicer's ReportCheckpoint hook (and once at job
+        end) — NEVER on a timer: a watermark persisted ahead of the model
+        checkpoint would make a restarted master skip shards whose gradient
+        updates the restored (older) model never received.  Coupling the
+        write to the checkpoint report keeps the pair consistent to within
+        the report's network latency.
+        """
+        if not self._progress_path:
+            return
+        import json
+
+        payload = json.dumps(self.dispatcher.progress(), sort_keys=True)
+        if payload == self._last_progress:
+            return
+        os.makedirs(os.path.dirname(self._progress_path), exist_ok=True)
+        tmp = f"{self._progress_path}.tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(payload)
+        os.replace(tmp, self._progress_path)
+        self._last_progress = payload
 
     @staticmethod
     def _advertise_host(config: JobConfig) -> str:
@@ -289,6 +364,7 @@ class Master:
                             "all worker pods terminated before the job finished"
                         )
                 time.sleep(poll_interval_s)
+            self._persist_progress()  # final watermark: job complete
             # Grace period (--shutdown_grace_s): workers that just learned
             # the job is finished are still writing their FINAL checkpoint
             # (orbax + host-tier store snapshots); tearing the fleet down

@@ -83,6 +83,7 @@ class MasterServicer:
         self._lock = threading.Lock()
         self._model_version = 0
         self._checkpoint: Dict[str, object] = {"path": None, "step": 0}
+        self._on_checkpoint = None  # master wires _persist_progress here
         # final_eval: run one last eval round after the training tasks drain,
         # BEFORE reporting the job finished (the reference's end-of-job eval).
         # Triggered inside GetTask so workers can't race past the job end.
@@ -379,7 +380,17 @@ class MasterServicer:
         with self._lock:
             if int(req["step"]) >= int(self._checkpoint["step"] or 0):
                 self._checkpoint = {"path": req["path"], "step": int(req["step"])}
+            cb = self._on_checkpoint
+        if cb is not None:
+            # Master persists the task watermark HERE — coupled to the model
+            # checkpoint, never ahead of it (a watermark newer than the
+            # restorable model would skip shards whose updates the restored
+            # model never saw).
+            cb(int(req["step"]))
         return {}
+
+    def set_checkpoint_callback(self, fn) -> None:
+        self._on_checkpoint = fn
 
     def JobStatus(self, req: dict) -> dict:
         status = self.dispatcher.counts()

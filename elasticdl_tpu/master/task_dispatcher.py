@@ -68,6 +68,7 @@ class TaskDispatcher:
         task_timeout_s: float = 600.0,
         max_task_retries: int = 3,
         clock: Callable[[], float] = time.monotonic,
+        resume: Optional[dict] = None,
     ):
         if num_epochs < 1:
             raise ValueError("num_epochs must be >= 1")
@@ -88,6 +89,10 @@ class TaskDispatcher:
         self._epoch = -1  # _refill brings it to 0
         self._finished = not self._shards
         self._stopped = False  # stop(): draining, nothing requeues
+        # Done shards of the CURRENT epoch, for ``progress()`` — the durable
+        # watermark a restarted master resumes from (SURVEY §5 "restore on
+        # master restart").
+        self._done_in_epoch: set = set()
         # Epoch-boundary events: (epoch, is_final) pairs queued under the
         # lock by _refill and delivered OUTSIDE it (the callback may start an
         # eval round, which has its own locks).  The master wires the
@@ -95,7 +100,61 @@ class TaskDispatcher:
         # (--evaluation_steps=0).
         self._on_epoch_end: Optional[Callable[[int, bool], None]] = None
         self._pending_epoch_end: List[Tuple[int, bool]] = []
-        self._refill()
+        if resume is not None and self._shards:
+            self._resume(resume)
+        else:
+            self._refill()
+
+    @staticmethod
+    def _shard_key(shard: Shard) -> Tuple[str, int, int]:
+        return (shard.name, shard.start, shard.end)
+
+    def _resume(self, progress: dict) -> None:
+        """Fast-forward to a persisted watermark: enter ``progress['epoch']``
+        with its already-done shards excluded from the todo queue.  A
+        watermark at/after the last epoch with everything done finishes
+        immediately (the job was complete when the old master died)."""
+        epoch = int(progress.get("epoch", 0))
+        done_keys = {tuple(k) for k in progress.get("done_shards", [])}
+        self._done_count = int(progress.get("done_count", 0))
+        if epoch >= self._num_epochs:
+            self._finished = True
+            return
+        self._epoch = epoch
+        known = {self._shard_key(s) for s in self._shards}
+        self._done_in_epoch = done_keys & known
+        for shard in self._shards:
+            if self._shard_key(shard) in self._done_in_epoch:
+                continue
+            self._todo.append(
+                Task(self._next_task_id, shard, self._task_type, self._epoch)
+            )
+            self._next_task_id += 1
+        if not self._todo:
+            # Every shard of the watermark epoch was done: move on (or
+            # finish, if it was the last).
+            self._done_in_epoch = set()
+            if self._epoch + 1 >= self._num_epochs:
+                self._finished = True
+            else:
+                self._refill()
+        # Epoch-end events generated while fast-forwarding describe epochs
+        # that ended BEFORE the crash — their eval rounds already ran; firing
+        # them again would emit duplicate metric rows.
+        self._pending_epoch_end.clear()
+
+    def progress(self) -> dict:
+        """The durable watermark: epoch + done shards within it + cumulative
+        done count.  Linear in done shards; the master persists it
+        atomically after reports (master/main.py)."""
+        with self._lock:
+            return {
+                "epoch": max(self._epoch, 0),
+                "done_shards": sorted(self._done_in_epoch),
+                "done_count": self._done_count,
+                "num_epochs": self._num_epochs,
+                "num_shards": len(self._shards),
+            }
 
     def set_epoch_end_callback(self, fn: Callable[[int, bool], None]) -> None:
         self._on_epoch_end = fn
@@ -115,6 +174,7 @@ class TaskDispatcher:
         if prev >= 0:
             self._pending_epoch_end.append((prev, False))
         self._epoch += 1
+        self._done_in_epoch = set()
         for shard in self._shards:
             self._todo.append(
                 Task(self._next_task_id, shard, self._task_type, self._epoch)
@@ -159,6 +219,8 @@ class TaskDispatcher:
                 return False
             if success:
                 self._done_count += 1
+                if entry.task.epoch == self._epoch:
+                    self._done_in_epoch.add(self._shard_key(entry.task.shard))
             elif self._stopped:
                 # Draining past --max_steps: a failed in-flight task is
                 # dropped, not requeued — requeueing would re-open dispatch
