@@ -29,7 +29,7 @@ import os
 import re
 import struct
 from collections import defaultdict
-from typing import Dict, Iterator, List, Tuple
+from typing import Dict, List, Tuple
 
 import numpy as np
 
