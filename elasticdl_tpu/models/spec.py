@@ -66,6 +66,12 @@ class HostTableIO:
     optimizer: str = "adagrad"
     learning_rate: float = 0.01
     init_scale: float = 0.05
+    # Sequence-parallel models ONLY: declares that ids_fn returns per-TOKEN
+    # ids [b, S(, ...)] whose dim 1 is the model's sequence dim, so the
+    # injected rows legally shard with the sequence.  Without the
+    # declaration a [b, F]-shaped table under SP would silently
+    # feature-slice — the trainer refuses instead (parallel/trainer.py).
+    per_token: bool = False
 
 
 @dataclasses.dataclass

@@ -76,6 +76,13 @@ def sp_batch_spec(axes: Tuple[str, ...], d: int) -> P:
     return P(*lead, axes[-1])
 
 
+def batch_leaf_spec(axes: Tuple[str, ...], d: int) -> P:
+    """The spec for a batch-shaped leaf of ndim > d under either layout —
+    the single selector used by input sharding, predict outputs, and host
+    cotangents, so the three cannot drift apart."""
+    return P(axes) if d == 0 else sp_batch_spec(axes, d)
+
+
 def _path_keys(path) -> Tuple[str, ...]:
     keys = []
     for entry in path:
@@ -198,11 +205,29 @@ class Trainer:
         self._remote_ps = False
         if spec.host_io:
             if spec.batch_shard_dim != 0:
-                raise NotImplementedError(
-                    "host-tier tables assume data-parallel batches "
-                    "(batch_shard_dim=0); sequence-parallel models cannot "
-                    "route per-example host rows yet"
-                )
+                # Single-process SP works for PER-TOKEN tables (ids [B, S]:
+                # the injected rows legally shard with the sequence — the
+                # HostTableIO.per_token declaration is the contract; a
+                # [B, F]-shaped table would silently feature-slice).
+                # Multi-process SP would additionally need per-PROCESS
+                # slicing of the sharded dim, which _local_example_range
+                # only does for the example dim.
+                not_per_token = [
+                    k for k, io in spec.host_io.items()
+                    if not getattr(io, "per_token", False)
+                ]
+                if not_per_token:
+                    raise NotImplementedError(
+                        "host-tier tables under sequence parallelism must "
+                        "declare per_token=True (ids [B, S]); table(s) "
+                        f"{not_per_token} do not"
+                    )
+                if _process_count(mesh) > 1:
+                    raise NotImplementedError(
+                        "host-tier tables with sequence parallelism are "
+                        "single-process only; multi-process meshes need "
+                        "per-token process slicing"
+                    )
             addrs = [
                 a.strip()
                 for a in getattr(config, "ps_addresses", "").split(",")
@@ -353,7 +378,7 @@ class Trainer:
         if d == 0:
             return P(self.batch_axes)
         if getattr(leaf, "ndim", 0) > d:
-            return sp_batch_spec(self.batch_axes, d)
+            return batch_leaf_spec(self.batch_axes, d)
         outer = self.batch_axes[:-1]
         if outer and getattr(leaf, "ndim", 0) >= 1:
             return P(outer)
@@ -757,7 +782,10 @@ def build_train_step(
 
     out_specs: Tuple = (state_specs, P())
     if host_keys:
-        out_specs = (state_specs, P(), {k: P(axes) for k in host_keys})
+        # Host cotangents mirror the injected leaf's batch layout
+        # (batch_leaf_spec — the same selector as input sharding).
+        host_spec = batch_leaf_spec(axes, spec.batch_shard_dim)
+        out_specs = (state_specs, P(), {k: host_spec for k in host_keys})
     mapped = shard_map(
         local_step,
         mesh=mesh,
@@ -786,10 +814,9 @@ def build_predict_step(
 
     d = spec.batch_shard_dim
     axes = tuple(batch_axes) if batch_axes else (axis,)
-    # Per-example outputs mirror the input batch layout: DP outputs shard
-    # the example dim over every axis; SP outputs use the shared
-    # sp_batch_spec so input and output layouts cannot drift apart.
-    out_spec = P(axes) if d == 0 else sp_batch_spec(axes, d)
+    # Per-example outputs mirror the input batch layout (batch_leaf_spec —
+    # the same selector as input sharding and host cotangents).
+    out_spec = batch_leaf_spec(axes, d)
     mapped = shard_map(
         local_predict,
         mesh=mesh,
