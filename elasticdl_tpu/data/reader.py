@@ -78,6 +78,14 @@ class RecordIODataReader(AbstractDataReader):
     def read_records(self, shard: Shard) -> Iterator[bytes]:
         return self._readers[shard.name].read_range(shard.start, shard.end)
 
+    def read_records_packed(self, shard: Shard):
+        """Bulk packed read (data/packed.py) — the worker's ingest hot path
+        uses this when a reader offers it; others fall back to
+        ``read_records``."""
+        return self._readers[shard.name].read_range_packed(
+            shard.start, shard.end
+        )
+
     def sources(self) -> List[str]:
         return sorted(self._readers)
 
@@ -145,6 +153,15 @@ class CompositeDataReader(AbstractDataReader):
         if reader is None:
             raise KeyError(f"no reader serves source {shard.name!r}")
         return reader.read_records(shard)
+
+    def read_records_packed(self, shard: Shard):
+        """Forward the packed fast path when the owning reader has one,
+        else None (the worker then uses ``read_records``)."""
+        reader = self._by_source.get(shard.name)
+        if reader is None:
+            raise KeyError(f"no reader serves source {shard.name!r}")
+        fast = getattr(reader, "read_records_packed", None)
+        return fast(shard) if fast is not None else None
 
     def sources(self) -> List[str]:
         return sorted(self._by_source)

@@ -19,6 +19,8 @@ import struct
 import zlib
 from typing import Iterator, List, Optional, Sequence
 
+import numpy as np
+
 MAGIC = b"EDLRIO\x00\x01"
 _HDR = struct.Struct("<II")
 
@@ -89,6 +91,32 @@ class RecordIOReader:
                 if zlib.crc32(payload) != crc:
                     raise IOError(f"{self.path}: CRC mismatch")
                 yield payload
+
+    def read_range_packed(self, start: int, end: int):
+        """Records [start, end) as one PackedRecords (bulk C++ read + CRC on
+        the ingest hot path; Python fallback when the native lib is absent).
+        See data/packed.py for why the hot path avoids per-record objects."""
+        from elasticdl_tpu.data.packed import PackedRecords
+
+        offsets = self.index()
+        end = min(end, len(offsets))
+        if start >= end:
+            return PackedRecords(
+                np.empty((0,), np.uint8), np.zeros((1,), np.int64)
+            )
+        try:
+            from elasticdl_tpu.ps.host_store import recordio_read_native
+
+            buf, cum = recordio_read_native(
+                self.path,
+                np.asarray(offsets, np.int64),
+                start,
+                end,
+                os.path.getsize(self.path),
+            )
+            return PackedRecords(buf, cum)
+        except (RuntimeError, ImportError):
+            return PackedRecords.from_records(list(self.read_range(start, end)))
 
 
 def write_records(path: str, records: Sequence[bytes]) -> int:

@@ -26,6 +26,7 @@ from elasticdl_tpu.common.config import JobConfig
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.metrics import finalize_metrics
 from elasticdl_tpu.common.rpc import PROTOCOL_VERSION, JsonRpcClient
+from elasticdl_tpu.data.prefetch import prefetch
 from elasticdl_tpu.data.reader import AbstractDataReader
 from elasticdl_tpu.master.task_dispatcher import (
     TASK_EVALUATION,
@@ -74,6 +75,9 @@ def _minibatches(
         chunk = records[start : start + batch_size]
         true_count = len(chunk)
         if true_count < batch_size:
+            # The tail de-packs to a plain list for the wrap; it is at most
+            # one minibatch per task, off the hot path.
+            chunk = list(chunk)
             reps = (batch_size + true_count - 1) // true_count
             chunk = (chunk * reps)[:batch_size]
         yield chunk, true_count
@@ -334,13 +338,26 @@ class Worker:
 
     # ---- task execution ----
 
+    def _read_records(self, shard):
+        """Shard records, packed (one bulk C++ read — data/packed.py) when
+        the reader offers it, else a plain list."""
+        fast = getattr(self.reader, "read_records_packed", None)
+        if fast is not None:
+            records = fast(shard)
+            if records is not None:
+                return records
+        return list(self.reader.read_records(shard))
+
     def _run_training_task(self, task: Task) -> Dict[str, float]:
-        records = list(self.reader.read_records(task.shard))
-        batches = (
-            self.spec.feed(chunk)
-            for chunk, _ in _minibatches(
-                records, self.config.minibatch_size, True
-            )
+        records = self._read_records(task.shard)
+        batches = prefetch(
+            (
+                self.spec.feed(chunk)
+                for chunk, _ in _minibatches(
+                    records, self.config.minibatch_size, True
+                )
+            ),
+            self.config.prefetch_depth,
         )
         # run_train_steps = (host-tier pull ->) shard -> jitted step
         # (-> sparse push) per batch; plain shard+step when no host tables.
@@ -362,19 +379,26 @@ class Worker:
         return finalize_metrics({k: np.asarray(s) / n for k, s in sums.items()})
 
     def _run_evaluation_task(self, task: Task) -> tuple:
-        records = list(self.reader.read_records(task.shard))
+        records = self._read_records(task.shard)
         sums: Dict[str, Any] = {}
         total = 0.0
-        for chunk, true_count in _minibatches(
-            records, self.config.minibatch_size, False
+
+        def _batches():
+            for chunk, true_count in _minibatches(
+                records, self.config.minibatch_size, False
+            ):
+                batch = dict(self.spec.feed(chunk))
+                # Real-vs-padding mask for the wrap-padded tail: metrics
+                # count only real rows (see models/metrics.py) — without it
+                # the duplicated examples were over-weighted.
+                batch["__mask__"] = (
+                    np.arange(self.config.minibatch_size) < true_count
+                ).astype(np.float32)
+                yield batch, true_count
+
+        for batch, true_count in prefetch(
+            _batches(), self.config.prefetch_depth
         ):
-            batch = dict(self.spec.feed(chunk))
-            # Real-vs-padding mask for the wrap-padded tail: metrics count
-            # only real rows (see models/metrics.py) — without it the
-            # duplicated examples were over-weighted.
-            batch["__mask__"] = (
-                np.arange(self.config.minibatch_size) < true_count
-            ).astype(np.float32)
             metrics = self.trainer.run_eval_step(self.state, batch)
             for k, v in metrics.items():
                 # Histogram metrics (streaming AUC) are vectors; accumulate
@@ -390,12 +414,17 @@ class Worker:
         }, total
 
     def _run_prediction_task(self, task: Task) -> None:
-        records = list(self.reader.read_records(task.shard))
+        records = self._read_records(task.shard)
         outs = []
-        for chunk, true_count in _minibatches(
-            records, self.config.minibatch_size, False
+        for batch, true_count in prefetch(
+            (
+                (self.spec.feed(chunk), count)
+                for chunk, count in _minibatches(
+                    records, self.config.minibatch_size, False
+                )
+            ),
+            self.config.prefetch_depth,
         ):
-            batch = self.spec.feed(chunk)
             out = self.trainer.run_predict_step(self.state, batch)
             outs.append(np.asarray(out)[:true_count])
         if self.config.prediction_outputs:

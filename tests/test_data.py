@@ -75,6 +75,124 @@ def test_criteo_codec_roundtrip():
     np.testing.assert_array_equal(batch["cat"][0], np.arange(26))
 
 
+def test_packed_records_sequence_semantics():
+    from elasticdl_tpu.data.packed import PackedRecords, as_packed
+
+    records = [b"alpha", b"", b"x" * 100, b"tail"]
+    packed = as_packed(records)
+    assert len(packed) == 4
+    assert list(packed) == records
+    assert packed[2] == records[2]
+    assert packed[-1] == b"tail"
+    view = packed[1:3]
+    assert isinstance(view, PackedRecords)
+    assert list(view) == records[1:3]
+    assert view.tobytes() == b"".join(records[1:3])
+    assert as_packed(packed) is packed
+    with pytest.raises(ValueError):
+        packed[::2]
+
+
+def test_criteo_native_decode_matches_python():
+    """The C++ decoder and the Python loop (the format's source of truth)
+    must agree bit-for-bit — including blanks, missing trailing fields,
+    negatives, decimals, and full-range hex ids."""
+    from elasticdl_tpu.data.packed import as_packed
+    from elasticdl_tpu.ps.host_store import native_lib_available
+
+    if not native_lib_available():
+        pytest.skip("native lib unavailable")
+    rng = np.random.default_rng(3)
+    records = [
+        codecs.encode_criteo_example(
+            int(rng.integers(0, 2)),
+            [None if rng.random() < 0.2 else int(rng.integers(-50, 1000))
+             for _ in range(13)],
+            [int(rng.integers(0, 1 << 32)) for _ in range(26)],
+        )
+        for _ in range(256)
+    ]
+    records.append(b"1")                      # label only
+    records.append(b"0\t\t\t")                # blank dense fields
+    records.append(b"1\t3.5\t-2.25\t1e2")     # decimals + exponent
+    records.append(b"0" + b"\t7" * 13 + b"\tdeadBEEF")  # mixed-case hex
+
+    def py_feed(recs):
+        n = len(recs)
+        dense = np.zeros((n, 13), np.float32)
+        cat = np.zeros((n, 26), np.int32)
+        labels = np.zeros((n,), np.int32)
+        for i, rec in enumerate(recs):
+            parts = rec.decode().split("\t")
+            labels[i] = int(parts[0])
+            for j, v in enumerate(parts[1:14]):
+                dense[i, j] = float(v) if v else 0.0
+            for j, v in enumerate(parts[14:]):
+                cat[i, j] = np.int32(np.uint32(int(v, 16))) if v else 0
+        return {"dense": dense, "cat": cat, "labels": labels}
+
+    ref = py_feed(records)
+    for form in (records, as_packed(records)):
+        out = codecs.criteo_feed(form)
+        for key in ref:
+            np.testing.assert_array_equal(ref[key], out[key], err_msg=key)
+
+
+def test_criteo_native_decode_rejects_malformed():
+    from elasticdl_tpu.ps.host_store import native_lib_available
+
+    if not native_lib_available():
+        pytest.skip("native lib unavailable")
+    with pytest.raises(ValueError, match="record 1"):
+        codecs.criteo_feed([b"1\t2", b"not-a-label\t2"])
+    with pytest.raises(ValueError):  # non-hex categorical
+        codecs.criteo_feed([b"1" + b"\t1" * 13 + b"\tzzzz"])
+
+
+def test_recordio_packed_read_and_crc(tmp_path):
+    path = str(tmp_path / "data.rio")
+    records = [b"hello", b"", b"x" * 10_000, bytes(range(256))]
+    write_records(path, records)
+    reader = RecordIOReader(path)
+    assert list(reader.read_range_packed(0, 4)) == records
+    assert list(reader.read_range_packed(1, 3)) == records[1:3]
+    assert list(reader.read_range_packed(3, 99)) == records[3:]
+    assert len(reader.read_range_packed(2, 2)) == 0
+    raw = bytearray(open(path, "rb").read())
+    raw[-3] ^= 0xFF
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises(IOError):
+        RecordIOReader(path).read_range_packed(0, 4)
+
+
+def test_reader_packed_matches_iter(tmp_path):
+    path = str(tmp_path / "d.rio")
+    write_records(path, [b"r%d" % i for i in range(25)])
+    reader = RecordIODataReader(path)
+    shard = Shard(path, 10, 20)
+    assert list(reader.read_records_packed(shard)) == list(
+        reader.read_records(shard)
+    )
+
+
+def test_prefetch_order_and_errors():
+    from elasticdl_tpu.data.prefetch import prefetch
+
+    assert list(prefetch(iter(range(100)), depth=3)) == list(range(100))
+    assert list(prefetch(iter(range(5)), depth=0)) == list(range(5))
+
+    def boom():
+        yield 1
+        yield 2
+        raise RuntimeError("decode failed")
+
+    it = prefetch(boom(), depth=2)
+    assert next(it) == 1
+    assert next(it) == 2
+    with pytest.raises(RuntimeError, match="decode failed"):
+        next(it)
+
+
 def test_census_codec_roundtrip():
     rec = codecs.encode_census_example(0, [39, 13, 0, 0, 40], ["private"] * 9)
     batch = codecs.census_feed([rec])
