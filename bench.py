@@ -1,9 +1,16 @@
 """Headline benchmark: DeepFM/Criteo training throughput, examples/sec/chip
 (BASELINE.json metric).
 
-Runs the full hybrid train step (mesh-sharded embedding tables + psum'd dense
-grads) on all available devices with synthetic Criteo-shaped data, measures
-steady-state steps/sec, prints ONE JSON line on stdout.
+Two phases, ONE JSON line:
+1. device-step: the full hybrid train step (mesh-sharded embedding tables +
+   psum'd dense grads) on all available devices, synthetic pre-sharded batch,
+   steady-state steps/sec — the device ceiling.
+2. end-to-end (tools/bench_e2e.py): the WHOLE worker path on a real recordio
+   file — master task dispatch, bulk C++ reads, C++ criteo decode, prefetch,
+   pipelined device steps.  This is the headline ``value``: it is what a
+   user's job sustains (VERDICT r3 Missing #1 demanded the end-to-end number
+   be the one of record); the device-step figure rides along as
+   ``device_step_examples_per_sec_per_chip``.
 
 Robustness (the round-1 bench produced *nothing* when the chip was flaky):
 - every phase (init / build / compile / warmup / measure) logs a timestamped
@@ -75,10 +82,16 @@ def _watchdog() -> None:
     os._exit(2)
 
 
-def _emit(value: float | None, *, partial: bool = False, error: str = "") -> None:
+def _emit(
+    value: float | None,
+    *,
+    partial: bool = False,
+    error: str = "",
+    extras: dict | None = None,
+) -> None:
     _state["emitted"] = True
     line = {
-        "metric": "deepfm_criteo_examples_per_sec_per_chip",
+        "metric": "deepfm_criteo_e2e_examples_per_sec_per_chip",
         "value": round(value, 1) if value is not None else None,
         "unit": "examples/sec/chip",
         "vs_baseline": (
@@ -87,6 +100,8 @@ def _emit(value: float | None, *, partial: bool = False, error: str = "") -> Non
             else None
         ),
     }
+    if extras:
+        line.update(extras)
     if partial:
         line["partial"] = True
         line["phase_reached"] = _state["phase"]
@@ -206,10 +221,34 @@ def main() -> None:
     step_ms = elapsed / MEASURE_STEPS * 1e3
     # 20 GFLOP is the GLOBAL batch's dense work; per-chip MFU divides by n.
     mfu = 20e9 / n / (elapsed / MEASURE_STEPS) / 197e12
-    _log("done", f"{eps_per_chip:,.0f} examples/sec/chip "
-                 f"({step_ms:.2f} ms/step, ~{mfu * 100:.1f}% MFU of v5e bf16 "
-                 f"peak — embedding-bound, see comment)")
-    _emit(eps_per_chip)
+    _log("device-step", f"{eps_per_chip:,.0f} examples/sec/chip "
+                        f"({step_ms:.2f} ms/step, ~{mfu * 100:.1f}% MFU of "
+                        f"v5e bf16 peak — embedding-bound, see comment)")
+    extras = {
+        "device_step_examples_per_sec_per_chip": round(eps_per_chip, 1),
+        "device_step_ms": round(step_ms, 3),
+    }
+
+    # Phase 2: end-to-end through the real worker loop (the headline).
+    _log("e2e", "running the full job stack on a recordio file")
+    try:
+        from tools.bench_e2e import run_e2e
+
+        e2e = run_e2e(log=lambda m: _log("e2e", m))
+    except Exception as e:
+        # The device-step figure is still a valid partial artifact.
+        _log("e2e-error", str(e)[:300])
+        _emit(None, partial=True, error=f"e2e failed: {e}", extras=extras)
+        raise
+    e2e_eps = e2e["e2e_examples_per_sec_per_chip"]
+    extras["e2e_detail"] = {
+        k: (round(v, 3) if isinstance(v, float) else v)
+        for k, v in e2e.items()
+        if k != "e2e_examples_per_sec_per_chip"
+    }
+    _log("done", f"end-to-end {e2e_eps:,.0f} examples/sec/chip "
+                 f"(device-step ceiling {eps_per_chip:,.0f})")
+    _emit(e2e_eps, extras=extras)
 
 
 if __name__ == "__main__":

@@ -91,6 +91,34 @@ def criteo_feed(records: Sequence[bytes]) -> dict:
     return {"dense": dense, "cat": cat, "labels": labels}
 
 
+def criteo_feed_pre(records: Sequence[bytes], buckets: int) -> dict:
+    """Criteo TSV -> PREPROCESSED batch: the DeepFM host-side feature
+    transforms (models/tabular.py hash_buckets + log_normalize) fused into
+    the C++ parse, emitting compact wire dtypes (labels uint8, dense float16
+    log1p, cat uint16 bucket ids).  The reference runs its preprocessing
+    layers inside the input pipeline the same way (SURVEY.md §2 #15); here
+    it also halves host->device bytes, the e2e bottleneck on
+    remote-attached chips.  Falls back to the raw feed + numpy transforms
+    when the native lib is unavailable (bit-compatible; pinned by tests)."""
+    try:
+        from elasticdl_tpu.ps.host_store import criteo_decode_pre_native
+
+        packed = as_packed(records)
+        labels, dense, cat = criteo_decode_pre_native(
+            packed.buf, packed.offsets, buckets
+        )
+        return {"dense": dense, "cat": cat, "labels": labels}
+    except (RuntimeError, ImportError):
+        raw = criteo_feed(records)
+        h = raw["cat"].astype(np.uint32) * np.uint32(2654435761)
+        h ^= h >> np.uint32(16)
+        return {
+            "dense": np.log1p(np.maximum(raw["dense"], 0.0)).astype(np.float16),
+            "cat": (h % np.uint32(buckets)).astype(np.uint16),
+            "labels": raw["labels"].astype(np.uint8),
+        }
+
+
 # ---------------- census (wide&deep) ----------------
 
 _CENSUS_DENSE = 5

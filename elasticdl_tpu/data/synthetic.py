@@ -39,17 +39,29 @@ def synthetic_cifar10(path: str, n: int, seed: int = 0) -> str:
     return path
 
 
-def synthetic_criteo(path: str, n: int, seed: int = 0) -> str:
-    """Criteo-Kaggle-shaped TSV with a planted CTR rule."""
+def synthetic_criteo(
+    path: str, n: int, seed: int = 0, container: str = "text"
+) -> str:
+    """Criteo-Kaggle-shaped TSV with a planted CTR rule.
+
+    ``container="text"`` writes newline-delimited TSV (the Kaggle dump's own
+    shape); ``"recordio"`` wraps each line in the recordio framing the
+    reference stores training data in (SURVEY.md §2 #14) — the e2e bench
+    uses this to exercise the native bulk-read path.
+    """
     rng = np.random.default_rng(seed)
-    with open(path, "wb") as f:
+    sink = RecordIOWriter(path) if container == "recordio" else open(path, "wb")
+    with sink as out:
         for _ in range(n):
             dense = rng.integers(0, 1000, 13)
             cats = rng.integers(0, 1 << 20, 26)
             score = 0.002 * dense[0] - 0.001 * dense[1] + ((cats[0] % 7) - 3) * 0.3
             label = int(rng.random() < 1 / (1 + np.exp(-score)))
-            f.write(codecs.encode_criteo_example(label, dense.tolist(), cats.tolist()))
-            f.write(b"\n")
+            rec = codecs.encode_criteo_example(label, dense.tolist(), cats.tolist())
+            if container == "recordio":
+                out.write(rec)
+            else:
+                out.write(rec + b"\n")
     return path
 
 

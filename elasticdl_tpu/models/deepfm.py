@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from elasticdl_tpu.data.codecs import criteo_feed
+from elasticdl_tpu.data.codecs import criteo_feed, criteo_feed_pre
 from elasticdl_tpu.models.spec import EmbeddingTableSpec, HostTableIO, ModelSpec
 from elasticdl_tpu.models.tabular import (
     bce_loss,
@@ -109,14 +109,27 @@ def _apply(
     compute_dtype=jnp.bfloat16,
     **_,
 ):
-    dense = log_normalize(batch["dense"])  # [b, 13] f32
+    # Pipeline-preprocessed batches (criteo_feed_pre) arrive with the host
+    # transforms already applied — float16 dense is log1p'd, uint16 cat ids
+    # are hashed bucket ids.  Dtype is static under jit, so this branch
+    # costs nothing at runtime.
+    d = batch["dense"]
+    dense = (
+        d.astype(jnp.float32) if d.dtype == jnp.float16 else log_normalize(d)
+    )
 
     if HOST_FM_KEY in batch:
         # Host-tier: vectors were pulled from the C++ store and injected by
         # the trainer; their cotangents flow back out as sparse grads.
         vecs = batch[HOST_FM_KEY]  # [b, 26, dim+1]
     else:
-        ids = fuse_feature_ids(batch["cat"], buckets_per_feature)  # [b, 26]
+        c = batch["cat"]
+        if c.dtype == jnp.uint16:  # pre-hashed: apply the feature offsets only
+            ids = c.astype(jnp.int32) + (
+                jnp.arange(NUM_CAT, dtype=jnp.int32) * buckets_per_feature
+            )
+        else:
+            ids = fuse_feature_ids(c, buckets_per_feature)  # [b, 26]
         vecs = embedding_lookup(
             params["fm_table"], ids, ctx, dim=embedding_dim + 1
         )
@@ -156,7 +169,13 @@ def _metrics(logits, batch, mask=None):
     return binary_metrics(logits, batch["labels"], mask)
 
 
-def _example_batch(batch_size: int):
+def _example_batch(batch_size: int, pre: bool = False):
+    if pre:
+        return {
+            "dense": jnp.zeros((batch_size, NUM_DENSE), jnp.float16),
+            "cat": jnp.zeros((batch_size, NUM_CAT), jnp.uint16),
+            "labels": jnp.zeros((batch_size,), jnp.uint8),
+        }
     return {
         "dense": jnp.zeros((batch_size, NUM_DENSE), jnp.float32),
         "cat": jnp.zeros((batch_size, NUM_CAT), jnp.int32),
@@ -171,11 +190,20 @@ def model_spec(
     embedding_dim: int = 8,
     hidden: Any = (400, 400),
     host_tier: Any = "auto",
+    pipeline_preprocess: Any = "auto",
 ) -> ModelSpec:
     """``host_tier``: True places the FM table in the native host store
     (ps/host_store) instead of HBM; "auto" promotes it when the padded table
     plus Adam moments would crowd a chip's HBM (ops.embedding guard) — the
     reference's external gRPC-PS tier, for vocabularies beyond mesh memory.
+
+    ``pipeline_preprocess``: run the feature transforms (hash bucketing +
+    log1p) in the input pipeline's C++ decoder instead of on device,
+    shipping compact dtypes (uint16/float16/uint8 — 79 B/example vs 160 B).
+    The reference's preprocessing layers live in the input pipeline the same
+    way (SURVEY.md §2 #15).  "auto" enables it for the mesh-tier model
+    whenever the bucket count fits uint16; the on-device transform path
+    remains for raw batches (numerics pinned equal by tests).
     """
     if isinstance(hidden, (list, tuple)):
         hidden = tuple(int(h) for h in hidden)
@@ -189,6 +217,16 @@ def model_spec(
 
         host_tier = exceeds_hbm_guard(vocab, dim + 1)
     host_tier = bool(host_tier)
+    if pipeline_preprocess == "auto":
+        # Host-tier pulls need the RAW ids (fuse_feature_ids_np over the
+        # full 32-bit space); uint16 bucket ids only exist for <= 2^16.
+        pipeline_preprocess = not host_tier and buckets_per_feature <= 65536
+    pipeline_preprocess = bool(pipeline_preprocess)
+    if pipeline_preprocess and (host_tier or buckets_per_feature > 65536):
+        raise ValueError(
+            "pipeline_preprocess requires the mesh-tier model and "
+            "buckets_per_feature <= 65536"
+        )
     return ModelSpec(
         name="deepfm",
         init=functools.partial(
@@ -231,8 +269,14 @@ def model_spec(
             if host_tier
             else {}
         ),
-        feed=criteo_feed,
-        example_batch=_example_batch,
+        feed=(
+            functools.partial(criteo_feed_pre, buckets=buckets_per_feature)
+            if pipeline_preprocess
+            else criteo_feed
+        ),
+        example_batch=functools.partial(
+            _example_batch, pre=pipeline_preprocess
+        ),
     )
 
 

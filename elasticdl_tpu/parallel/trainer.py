@@ -524,9 +524,19 @@ class Trainer:
         return state, metrics
 
     def run_train_steps(
-        self, state: TrainState, batches, use_async: bool = False
+        self,
+        state: TrainState,
+        batches,
+        use_async: bool = False,
+        pre_sharded: bool = False,
     ):
         """Train over an iterable of HOST batches.
+
+        ``pre_sharded=True``: the batches are ALREADY device-placed (the
+        worker's prefetch thread ran ``shard_batch``, overlapping the H2D
+        transfer with the in-flight device step — on a remote/tunneled chip
+        a synchronous device_put costs a full RTT per batch).  Only legal
+        without host-tier tables: host injection needs the host batch.
 
         ``use_async=False``: the synchronous loop — each batch's pull sees
         every prior push (sync-by-version PS semantics).
@@ -544,6 +554,16 @@ class Trainer:
         Returns (state, [metrics per batch]).
         """
         metrics_out = []
+        if pre_sharded:
+            if self.spec.host_io:
+                raise ValueError(
+                    "pre_sharded batches are incompatible with host-tier "
+                    "tables (the host pull needs the host batch)"
+                )
+            for batch in batches:
+                state, metrics = self.train_step(state, batch)
+                metrics_out.append(metrics)
+            return state, metrics_out
         if not self.spec.host_io or not use_async:
             for batch in batches:
                 state, metrics = self.run_train_step(state, batch)

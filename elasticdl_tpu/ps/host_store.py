@@ -32,6 +32,7 @@ _i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
 _f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
 _i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
 _u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+_u16p = np.ctypeslib.ndpointer(np.uint16, flags="C_CONTIGUOUS")
 
 
 def _build() -> None:
@@ -92,6 +93,10 @@ def _load() -> ctypes.CDLL:
         ]
         lib.edl_criteo_decode.restype = _i64
         lib.edl_criteo_decode.argtypes = [_u8p, _i64p, _i64, _i32p, _f32p, _i32p]
+        lib.edl_criteo_decode_pre.restype = _i64
+        lib.edl_criteo_decode_pre.argtypes = [
+            _u8p, _i64p, _i64, _u8p, _u16p, _u16p, _i64,
+        ]
         _lib = lib
         return lib
 
@@ -259,3 +264,31 @@ def criteo_decode_native(buf: np.ndarray, offsets: np.ndarray) -> tuple:
         bad = bytes(buf[offsets[i] : offsets[i + 1]])
         raise ValueError(f"malformed criteo record {i}: {bad[:120]!r}")
     return labels, dense, cat
+
+
+def criteo_decode_pre_native(
+    buf: np.ndarray, offsets: np.ndarray, buckets: int
+) -> tuple:
+    """Preprocessed criteo decode: the model's host-side feature transforms
+    (models/tabular.py hash_buckets + log_normalize) applied DURING the
+    parse, emitting compact wire types — labels uint8, dense float16
+    (log1p), cat uint16 in [0, buckets).  79 B/example vs the raw decode's
+    160 B: the host->device link is the e2e bottleneck on remote-attached
+    chips (docs/perf.md).  Requires buckets <= 65536."""
+    lib = _load()
+    buf = np.ascontiguousarray(buf, np.uint8)
+    offsets = np.ascontiguousarray(offsets, np.int64)
+    n = len(offsets) - 1
+    labels = np.zeros((n,), np.uint8)
+    dense = np.zeros((n, 13), np.uint16)
+    cat = np.zeros((n, 26), np.uint16)
+    rc = int(
+        lib.edl_criteo_decode_pre(buf, offsets, n, labels, dense, cat, buckets)
+    )
+    if rc == -(n + 1):
+        raise ValueError(f"buckets={buckets} out of range for uint16 decode")
+    if rc < 0:
+        i = -rc - 1
+        bad = bytes(buf[offsets[i] : offsets[i + 1]])
+        raise ValueError(f"malformed criteo record {i}: {bad[:120]!r}")
+    return labels, dense.view(np.float16), cat

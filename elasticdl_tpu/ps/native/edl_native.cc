@@ -394,9 +394,42 @@ static inline const uint8_t* criteo_float(const uint8_t* p, const uint8_t* end,
   return p;
 }
 
-int64_t edl_criteo_decode(const uint8_t* buf, const int64_t* offsets,
-                          int64_t n, int32_t* labels, float* dense,
-                          int32_t* cat) {
+// float32 -> float16 bits, round-to-nearest-even (matches numpy's cast).
+static inline uint16_t f32_to_f16(float f) {
+  uint32_t x;
+  std::memcpy(&x, &f, 4);
+  const uint32_t sign = (x >> 16) & 0x8000u;
+  const int32_t exp = (int32_t)((x >> 23) & 0xffu) - 127 + 15;
+  const uint32_t mant = x & 0x7fffffu;
+  if (exp <= 0) {
+    if (exp < -10) return (uint16_t)sign;  // underflow to signed zero
+    // subnormal half
+    uint32_t m = (mant | 0x800000u) >> (1 - exp);
+    uint32_t half = sign | (m >> 13);
+    uint32_t rem = m & 0x1fffu;
+    if (rem > 0x1000u || (rem == 0x1000u && (half & 1))) half++;
+    return (uint16_t)half;
+  }
+  if (exp >= 31) return (uint16_t)(sign | 0x7c00u);  // overflow -> inf
+  uint32_t half = sign | ((uint32_t)exp << 10) | (mant >> 13);
+  const uint32_t rem = mant & 0x1fffu;
+  if (rem > 0x1000u || (rem == 0x1000u && (half & 1))) half++;
+  return (uint16_t)half;
+}
+
+}  // extern "C" — paused: templates need C++ linkage; resumed below.
+
+// Shared criteo parse core.  PRE=false fills raw arrays (labels int32,
+// dense float32, cat int32 = the hex id bit-cast).  PRE=true applies the
+// model's host-side preprocessing during the parse — the reference runs its
+// preprocessing layers inside the input pipeline the same way (SURVEY.md
+// §2 #15) — emitting labels uint8, dense float16 log1p, cat uint16 hashed
+// into [0, buckets) with the models/tabular.py multiplicative hash.  The
+// compact forms exist to cut PCIe/link bytes per example (160 B -> 79 B).
+template <bool PRE, typename LabelT, typename DenseT, typename CatT>
+static int64_t criteo_parse(const uint8_t* buf, const int64_t* offsets,
+                            int64_t n, LabelT* labels, DenseT* dense,
+                            CatT* cat, uint32_t buckets) {
   if (!hex_ready) hex_init();
   for (int64_t i = 0; i < n; i++) {
     const uint8_t* p = buf + offsets[i];
@@ -406,11 +439,12 @@ int64_t edl_criteo_decode(const uint8_t* buf, const int64_t* offsets,
     bool any = false;
     while (p < rec_end && *p >= '0' && *p <= '9') { lab = lab * 10 + (*p++ - '0'); any = true; }
     if (!any || (p < rec_end && *p != '\t')) return -(i + 1);
-    labels[i] = (int32_t)lab;
-    // 13 dense fields (blank -> 0.0); output rows pre-zeroed by the caller.
+    labels[i] = (LabelT)lab;
+    // 13 dense fields (blank -> 0.0); output rows pre-zeroed by the caller
+    // (for PRE, transform(0) == 0 so missing fields stay correct).
     // Fast path: plain (possibly signed) integers — what the Kaggle dump
     // holds — parsed in one pass; anything else re-parses as a float.
-    float* drow = dense + i * 13;
+    DenseT* drow = dense + i * 13;
     for (int j = 0; j < 13 && p < rec_end; j++) {
       p++;  // consume the '\t' that ended the previous field
       const uint8_t* fstart = p;
@@ -418,22 +452,36 @@ int64_t edl_criteo_decode(const uint8_t* buf, const int64_t* offsets,
       if (p < rec_end && *p == '-') { neg = true; p++; }
       int64_t v = 0;
       while (p < rec_end && (uint8_t)(*p - '0') < 10) v = v * 10 + (*p++ - '0');
+      float val;
+      bool got = false;
       if (p == rec_end || *p == '\t') {
-        if (p > fstart + (neg ? 1 : 0))
-          drow[j] = (float)(neg ? -v : v);
-        else if (neg)
+        if (p > fstart + (neg ? 1 : 0)) {
+          val = (float)(neg ? -v : v);
+          got = true;
+        } else if (neg) {
           return -(i + 1);  // a bare "-" is not a number (match float('-'))
+        }
       } else {
         const uint8_t* fend = p;
         while (fend < rec_end && *fend != '\t') fend++;
         bool ok;
-        criteo_float(fstart, fend, &drow[j], &ok);
+        criteo_float(fstart, fend, &val, &ok);
         if (!ok) return -(i + 1);
         p = fend;
+        got = true;
+      }
+      if (got) {
+        if (PRE) {
+          // models/tabular.py log_normalize: log1p(max(x, 0)), then the
+          // numpy-identical round-to-nearest f16 cast.
+          drow[j] = (DenseT)f32_to_f16(std::log1p(val > 0.0f ? val : 0.0f));
+        } else {
+          drow[j] = (DenseT)val;
+        }
       }
     }
     // 26 categorical hex ids (blank -> 0), via a 256-entry nibble LUT.
-    int32_t* crow = cat + i * 26;
+    CatT* crow = cat + i * 26;
     for (int j = 0; j < 26 && p < rec_end; j++) {
       p++;
       uint32_t v = 0;
@@ -445,11 +493,40 @@ int64_t edl_criteo_decode(const uint8_t* buf, const int64_t* offsets,
         got = true;
         p++;
       }
-      if (got) crow[j] = (int32_t)v;
+      if (got) {
+        if (PRE) {
+          // models/tabular.py hash_buckets: h = id * 2654435761 (uint32
+          // wraparound); h ^= h >> 16; h % buckets.
+          uint32_t h = v * 2654435761u;
+          h ^= h >> 16;
+          crow[j] = (CatT)(h % buckets);
+        } else {
+          crow[j] = (CatT)(int32_t)v;
+        }
+      }
     }
     if (p != rec_end) return -(i + 1);  // surplus fields: malformed
   }
   return 0;
+}
+
+extern "C" {
+
+int64_t edl_criteo_decode(const uint8_t* buf, const int64_t* offsets,
+                          int64_t n, int32_t* labels, float* dense,
+                          int32_t* cat) {
+  return criteo_parse<false>(buf, offsets, n, labels, dense, cat, 0u);
+}
+
+// Preprocessed decode: labels uint8, dense float16 (log1p-normalized), cat
+// uint16 (hashed into [0, buckets); requires buckets <= 65536).  Halves the
+// host->device bytes per example — see criteo_parse.
+int64_t edl_criteo_decode_pre(const uint8_t* buf, const int64_t* offsets,
+                              int64_t n, uint8_t* labels, uint16_t* dense,
+                              uint16_t* cat, int64_t buckets) {
+  if (buckets < 1 || buckets > 65536) return -(n + 1);
+  return criteo_parse<true>(buf, offsets, n, labels, dense, cat,
+                            (uint32_t)buckets);
 }
 
 // CRC-verify records [start, end) given their offsets; returns the index of

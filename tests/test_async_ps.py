@@ -127,9 +127,11 @@ def test_worker_task_uses_async_driver(devices, monkeypatch):
     seen = {}
     orig = Trainer.run_train_steps
 
-    def spy(self, state, batches, use_async=False):
+    def spy(self, state, batches, use_async=False, pre_sharded=False):
         seen["use_async"] = use_async
-        return orig(self, state, batches, use_async=use_async)
+        return orig(
+            self, state, batches, use_async=use_async, pre_sharded=pre_sharded
+        )
 
     monkeypatch.setattr(Trainer, "run_train_steps", spy)
     task = Task(task_id=0, shard=Shard(name=path, start=0, end=48), type=TASK_TRAINING)

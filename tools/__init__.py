@@ -1,0 +1,5 @@
+"""Operational tools: benches, profilers, experiment harnesses.
+
+Importable as a package so bench.py can reuse tools/bench_e2e.py; each tool
+also runs standalone (``python tools/<name>.py``).
+"""

@@ -1,0 +1,142 @@
+"""End-to-end DeepFM/Criteo training throughput — the WHOLE worker path.
+
+Unlike bench.py's device-step phase (one pre-sharded synthetic batch reused
+every step), this runs the real job stack on real files: recordio on disk ->
+master task dispatch -> worker shard read (bulk C++ recordio read) -> criteo
+decode (C++ codec) -> prefetch -> shard_batch -> jitted hybrid train step,
+for every batch.  The number it reports is what a user's `elasticdl train`
+job actually sustains per chip (SURVEY.md §3.1-3.3; the reference's
+tf.data-fed worker loop is the parity target — VERDICT r3 Missing #1).
+
+Measurement: per-task completion timestamps via a wrapping master proxy;
+the first ``WARM_TASKS`` tasks (XLA compile + cache warmup) are excluded,
+throughput = records in the remaining tasks / the time they took.
+
+Standalone: ``python tools/bench_e2e.py`` prints the result dict.
+bench.py imports ``run_e2e`` for the committed artifact.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MINIBATCH = 8192
+MINIBATCHES_PER_TASK = 8  # the reference's num_minibatches_per_task default
+RECORDS_PER_TASK = MINIBATCH * MINIBATCHES_PER_TASK
+FILE_TASKS = 2          # tasks per epoch; the file holds this many
+WARM_TASKS = 2          # excluded from the measurement (compile + warmup)
+MEASURE_TASKS = 46      # ~3M examples measured
+
+_CACHE_VERSION = 1  # bump when the synthetic generator's output changes
+
+
+def _dataset(tmp_dir: str = "/tmp") -> str:
+    """Synthetic criteo recordio, cached across runs (generation is a
+    Python-loop one-time cost, ~30 us/record)."""
+    from elasticdl_tpu.data.synthetic import synthetic_criteo
+
+    n = RECORDS_PER_TASK * FILE_TASKS
+    path = os.path.join(tmp_dir, f"edl_bench_criteo_v{_CACHE_VERSION}_{n}.rio")
+    if not os.path.exists(path):
+        tmp = path + ".tmp"
+        synthetic_criteo(tmp, n, seed=11, container="recordio")
+        os.replace(tmp, path)
+    return path
+
+
+def run_e2e(log=lambda msg: None) -> dict:
+    import jax
+
+    from elasticdl_tpu.common.config import DistributionStrategy, JobConfig
+    from elasticdl_tpu.data.reader import create_data_reader
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.models.spec import load_model_spec
+    from elasticdl_tpu.worker.worker import DirectMasterProxy, Worker
+
+    path = _dataset()
+    log(f"dataset ready: {path} ({os.path.getsize(path) >> 20} MiB)")
+
+    total_tasks = WARM_TASKS + MEASURE_TASKS
+    epochs = -(-total_tasks // FILE_TASKS)  # ceil; runs epochs*FILE_TASKS tasks
+    total_tasks = epochs * FILE_TASKS
+    config = JobConfig(
+        model_def="deepfm.model_spec",
+        model_params="buckets_per_feature=65536;embedding_dim=8;hidden=[400,400]",
+        distribution_strategy=DistributionStrategy.PARAMETER_SERVER,
+        training_data=path,
+        minibatch_size=MINIBATCH,
+        num_minibatches_per_task=MINIBATCHES_PER_TASK,
+        num_epochs=epochs,
+    )
+    reader = create_data_reader(path)
+    dispatcher = TaskDispatcher(
+        reader.create_shards(RECORDS_PER_TASK), num_epochs=epochs
+    )
+    servicer = MasterServicer(dispatcher)
+    spec = load_model_spec(
+        "elasticdl_tpu.models",
+        "deepfm.model_spec",
+        buckets_per_feature=65536,
+        embedding_dim=8,
+        hidden=(400, 400),
+    )
+
+    reports = []
+
+    class TimingProxy(DirectMasterProxy):
+        def call(self, method, request):
+            resp = super().call(method, request)
+            if method == "ReportTaskResult":
+                reports.append(time.perf_counter())
+                if len(reports) % 16 == 0:
+                    log(f"{len(reports)} tasks done")
+            return resp
+
+    worker = Worker(
+        config,
+        TimingProxy(servicer),
+        reader,
+        worker_id="bench-w0",
+        spec=spec,
+        devices=jax.devices(),
+    )
+    log(f"running {total_tasks} tasks x {RECORDS_PER_TASK} records "
+        f"(epochs={epochs})")
+    t_start = time.perf_counter()
+    result = worker.run()
+    t_total = time.perf_counter() - t_start
+
+    if len(reports) <= WARM_TASKS:
+        raise RuntimeError(
+            f"only {len(reports)} tasks completed; nothing to measure"
+        )
+    measured = len(reports) - WARM_TASKS
+    elapsed = reports[-1] - reports[WARM_TASKS - 1]
+    examples = measured * RECORDS_PER_TASK
+    n_chips = len(jax.devices())
+    return {
+        "e2e_examples_per_sec_per_chip": examples / elapsed / n_chips,
+        "tasks_measured": measured,
+        "examples_measured": examples,
+        "elapsed_s": elapsed,
+        "wall_total_s": t_total,
+        "steps": result["step"],
+        "warm_tasks_excluded": WARM_TASKS,
+    }
+
+
+if __name__ == "__main__":
+    from elasticdl_tpu.common.platform import (
+        apply_platform_env,
+        enable_compile_cache,
+    )
+
+    apply_platform_env()
+    enable_compile_cache()
+    out = run_e2e(log=lambda m: print(f"[e2e] {m}", file=sys.stderr, flush=True))
+    print(out)
