@@ -134,10 +134,13 @@ def _apply(
     return x @ head["w"] + head["b"]
 
 
-def _loss(logits, batch):
-    return optax.softmax_cross_entropy_with_integer_labels(
+def _loss(logits, batch, mask=None):
+    from elasticdl_tpu.models.metrics import masked_mean
+
+    ce = optax.softmax_cross_entropy_with_integer_labels(
         logits, batch["labels"]
-    ).mean()
+    )
+    return masked_mean(ce, mask)
 
 
 def _metrics(logits, batch, mask=None):

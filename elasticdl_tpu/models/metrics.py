@@ -18,12 +18,19 @@ import jax.numpy as jnp
 
 
 def masked_mean(values: jax.Array, mask: Optional[jax.Array] = None) -> jax.Array:
-    """Mean of per-example ``values`` [b] over real examples only."""
+    """Mean of per-example ``values`` over real examples only.
+
+    ``values`` may carry trailing per-example dims (e.g. per-token CE
+    [b, s]); the [b] mask broadcasts across them, so every real example's
+    elements weigh equally."""
     values = values.astype(jnp.float32)
     if mask is None:
         return jnp.mean(values)
     m = mask.astype(jnp.float32)
-    return jnp.sum(values * m) / jnp.maximum(jnp.sum(m), 1e-12)
+    if m.ndim < values.ndim:
+        m = m.reshape(m.shape + (1,) * (values.ndim - m.ndim))
+    w = jnp.broadcast_to(m, values.shape)
+    return jnp.sum(values * w) / jnp.maximum(jnp.sum(w), 1e-12)
 
 
 #: Score-histogram resolution for streaming AUC.  512 buckets bounds the

@@ -70,9 +70,12 @@ def _apply(params, batch, train: bool = False, compute_dtype=jnp.bfloat16, **_):
     return logits.astype(jnp.float32)
 
 
-def _loss(logits, batch):
+def _loss(logits, batch, mask=None):
+    from elasticdl_tpu.models.metrics import masked_mean
+
     labels = batch["labels"]
-    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    return masked_mean(ce, mask)
 
 
 def _metrics(logits, batch, mask=None) -> Dict[str, Any]:

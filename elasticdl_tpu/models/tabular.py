@@ -84,8 +84,13 @@ def binary_metrics(logits: jax.Array, labels: jax.Array, mask=None) -> dict:
     }
 
 
-def bce_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+def bce_loss(logits: jax.Array, labels: jax.Array, mask=None) -> jax.Array:
+    """BCE over real examples only (mask: wrap-padded training/eval tails —
+    padding carries zero loss, hence zero gradient)."""
+    from elasticdl_tpu.models.metrics import masked_mean
+
     labels_f = labels.astype(jnp.float32)
-    return jnp.mean(
+    per_example = (
         jnp.maximum(logits, 0) - logits * labels_f + jnp.log1p(jnp.exp(-jnp.abs(logits)))
     )
+    return masked_mean(per_example, mask)

@@ -124,12 +124,16 @@ def _apply(
     return (x @ params["tok_emb"].T.astype(compute_dtype)).astype(jnp.float32)
 
 
-def _loss(logits, batch):
-    # Mean CE over this device's tokens; the trainer's /n + psum makes it
-    # the global mean (equal chunk sizes by construction).
-    return optax.softmax_cross_entropy_with_integer_labels(
-        logits.reshape(-1, logits.shape[-1]), batch["labels"].reshape(-1)
-    ).mean()
+def _loss(logits, batch, mask=None):
+    # Mean CE over this device's tokens (mask: whole padded SEQUENCES carry
+    # zero weight); the trainer's count/total weighting makes it the global
+    # mean.
+    from elasticdl_tpu.models.metrics import masked_mean
+
+    ce = optax.softmax_cross_entropy_with_integer_labels(
+        logits, batch["labels"]
+    )
+    return masked_mean(ce, mask)
 
 
 def _metrics(logits, batch):

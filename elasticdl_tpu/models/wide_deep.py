@@ -120,8 +120,8 @@ def _apply(
     return wide + deep + params["bias"][0]
 
 
-def _loss(logits, batch):
-    return bce_loss(logits, batch["labels"])
+def _loss(logits, batch, mask=None):
+    return bce_loss(logits, batch["labels"], mask)
 
 
 def _metrics(logits, batch, mask=None):

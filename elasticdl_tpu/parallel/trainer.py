@@ -39,8 +39,10 @@ from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from elasticdl_tpu.common.config import DistributionStrategy, JobConfig
+from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.metrics import HIST_PREFIX
 from elasticdl_tpu.models.spec import EmbeddingTableSpec, ModelSpec
+logger = get_logger("trainer")
 from elasticdl_tpu.ops.embedding import (
     ParallelContext,
     pack_table,
@@ -646,8 +648,34 @@ class Trainer:
             # checkpoint step (pushed gradients are never un-applied — the
             # reference PS behaves identically).  PS pods restore their own
             # slices from the newest complete snapshot when THEY (re)start
-            # (ps/main.py); the worker-side restore is therefore a no-op
-            # that reports the tier as intact.
+            # (ps/main.py).  The worker still VERIFIES fleet consistency
+            # here: shards restore independently, so a crash can leave them
+            # on different steps — and an evaluation/prediction job whose
+            # fleet restored nothing would silently score freshly
+            # initialized rows.  Fail those loud; training re-joins
+            # error-log and continue (bounded-staleness tolerance).
+            steps = next(iter(self._host_stores.values())).restored_steps()
+            distinct = set(steps)
+            scoring = self.config.job_type in ("evaluation", "prediction")
+            if distinct == {None}:
+                # Whole fleet fresh: fine mid-training (rows accumulated
+                # since job start live only in memory until the first
+                # snapshot), fatal when scoring a trained model.
+                if scoring:
+                    raise RuntimeError(
+                        f"{self.config.job_type} job: no PS shard restored "
+                        "any snapshot — refusing to score freshly "
+                        "initialized embedding rows"
+                    )
+                return True
+            if len(distinct) > 1:
+                msg = (
+                    f"PS shards restored divergent steps {steps} — the "
+                    "fleet mixes model versions"
+                )
+                if scoring:
+                    raise RuntimeError(msg)
+                logger.error("%s; continuing (async-PS training tolerance)", msg)
             return True
         paths = {
             key: os.path.join(directory, "host_stores", str(step), f"{key}.bin")
@@ -763,17 +791,36 @@ def build_train_step(
     # lookup's transpose sums them within the embedding axis already.
     grad_skip = {t.path for t in spec.embedding_tables} if ctx.sharded_embeddings else set()
 
+    # Wrap-padded training tails: the worker marks real rows in
+    # ``__mask__`` (exactly as eval does); padded duplicates then carry
+    # ZERO loss — hence zero gradient, dense and sparse alike — and the
+    # cross-device combine weights each shard by its REAL count:
+    # psum(local_masked_mean * count) / psum(count).  Without a mask the
+    # math reduces to the old equal-shards /n + psum form bit-for-bit.
+    # Loss fns without a mask parameter (user models) train on the padded
+    # batch as before.
+    wants_mask = "mask" in inspect.signature(spec.loss).parameters
+    wants_metric_mask = "mask" in inspect.signature(spec.metrics).parameters
+
     def local_step(state: TrainState, batch):
         n = 1
         for a in axes:
             n *= lax.axis_size(a)
         batch = dict(batch)
+        mask = batch.pop("__mask__", None) if wants_mask else None
         host_in = {k: batch.pop(k) for k in host_keys}
+        if mask is not None:
+            count = jnp.sum(mask.astype(jnp.float32))
+            total = jnp.maximum(lax.psum(count, axes), 1e-12)
 
         def loss_fn(params, host_embs):
             merged = dict(batch)
             merged.update(host_embs)
             out = spec.apply(params, merged, train=True, ctx=ctx)
+            if mask is not None:
+                # count/total are constants w.r.t. params; the psum above
+                # traces fine under grad.
+                return spec.loss(out, merged, mask=mask) * count / total, out
             return spec.loss(out, merged) / n, out
 
         (loss, out), (grads, host_grads) = jax.value_and_grad(
@@ -787,11 +834,19 @@ def build_train_step(
         # EVAL machinery — per-minibatch training AUC is noise, and the
         # reference computes AUC only in evaluation — so the train step
         # drops them before the collective mean.
-        metrics = {
-            k: lax.pmean(v, axes)
-            for k, v in spec.metrics(out, batch).items()
-            if not k.startswith(HIST_PREFIX)
-        }
+        if mask is not None and wants_metric_mask:
+            raw = spec.metrics(out, batch, mask=mask)
+            metrics = {
+                k: lax.psum(v * count, axes) / total
+                for k, v in raw.items()
+                if not k.startswith(HIST_PREFIX)
+            }
+        else:
+            metrics = {
+                k: lax.pmean(v, axes)
+                for k, v in spec.metrics(out, batch).items()
+                if not k.startswith(HIST_PREFIX)
+            }
         metrics["loss"] = loss
         new_state = TrainState(step=state.step + 1, params=params, opt_state=opt_state)
         if host_keys:

@@ -78,6 +78,8 @@ def _load() -> ctypes.CDLL:
         lib.edl_store_size.restype = _i64
         lib.edl_store_size.argtypes = [ctypes.c_void_p]
         lib.edl_store_pull.argtypes = [ctypes.c_void_p, _i64p, _i64, _f32p]
+        lib.edl_store_try_pull.restype = _i64
+        lib.edl_store_try_pull.argtypes = [ctypes.c_void_p, _i64p, _i64, _f32p]
         lib.edl_store_push_grad.argtypes = [ctypes.c_void_p, _i64p, _i64, _f32p]
         lib.edl_store_save.restype = _i64
         lib.edl_store_save.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
@@ -150,6 +152,18 @@ class HostEmbeddingStore:
         out = np.empty((ids.size, self.dim), np.float32)
         self._lib.edl_store_pull(self._ptr, ids.ravel(), ids.size, out)
         return out.reshape(ids.shape + (self.dim,))
+
+    def try_pull(self, ids: np.ndarray):
+        """Read-only gather: (rows, n_missing).  Safe to run concurrently
+        with other readers (NOT with push/pull/load); the PS service uses it
+        as the shared-lock fast path and falls back to the exclusive
+        ``pull`` when ids are missing."""
+        ids = np.ascontiguousarray(ids, np.int64)
+        out = np.empty((ids.size, self.dim), np.float32)
+        missing = int(
+            self._lib.edl_store_try_pull(self._ptr, ids.ravel(), ids.size, out)
+        )
+        return out.reshape(ids.shape + (self.dim,)), missing
 
     def push_grad(self, ids: np.ndarray, grads: np.ndarray) -> None:
         ids = np.ascontiguousarray(ids, np.int64).ravel()

@@ -146,6 +146,27 @@ void edl_store_pull(EdlStore* s, const int64_t* ids, int64_t n, float* out) {
   }
 }
 
+// Read-only gather: fills out[n*dim] for ids that EXIST; returns the number
+// of missing ids (their rows are left untouched).  Never mutates the store,
+// so any number of threads may call it concurrently as long as no writer
+// (push/pull-create/load) runs — the PS service's reader-writer fast path
+// (ps/service.py): steady-state training pulls hit only existing rows and
+// scale across the gRPC thread pool instead of serializing on one mutex.
+int64_t edl_store_try_pull(EdlStore* s, const int64_t* ids, int64_t n,
+                           float* out) {
+  int64_t missing = 0;
+  for (int64_t i = 0; i < n; i++) {
+    auto it = s->index.find(ids[i]);
+    if (it == s->index.end()) {
+      missing++;
+      continue;
+    }
+    std::memcpy(out + i * s->dim, s->arena.data() + it->second,
+                sizeof(float) * s->dim);
+  }
+  return missing;
+}
+
 // Sparse apply: ids may contain duplicates — contributions are accumulated
 // before one optimizer step per distinct row (IndexedSlices semantics).
 void edl_store_push_grad(EdlStore* s, const int64_t* ids, int64_t n,

@@ -380,6 +380,18 @@ class Worker:
         n_steps = (len(records) + mb - 1) // mb
         pre_shard = not self.spec.host_io
 
+        def _train_feed(chunk, true_count):
+            """Feed a train chunk; wrap-padded tails get the eval-style
+            ``__mask__`` so duplicated examples carry ZERO gradient (the
+            train step weights shards by real count — build_train_step)."""
+            batch = self.spec.feed(chunk)
+            if true_count < mb:
+                batch = dict(batch)
+                batch["__mask__"] = (np.arange(mb) < true_count).astype(
+                    np.float32
+                )
+            return batch
+
         if pre_shard and self.config.prefetch_depth > 0 and len(records) >= mb:
             # Whole-task batch prep: ONE feed call over every full minibatch
             # and ONE H2D transfer, then per-step device-side slices.  On a
@@ -392,11 +404,11 @@ class Worker:
             # shard-local (minibatch divisibility is enforced by
             # shard_batch), so each step's inputs cost three tiny async
             # dispatches instead of host work.
-            batches = self._whole_task_batches(records, mb)
+            batches = self._whole_task_batches(records, mb, _train_feed)
         else:
             def _gen():
-                for chunk, _ in _minibatches(records, mb, True):
-                    batch = self.spec.feed(chunk)
+                for chunk, true_count in _minibatches(records, mb, True):
+                    batch = _train_feed(chunk, true_count)
                     yield (
                         self.trainer.shard_batch(batch) if pre_shard else batch
                     )
@@ -422,7 +434,7 @@ class Worker:
                 leaf.copy_to_host_async()
         return metrics_list, n_steps
 
-    def _whole_task_batches(self, records, mb: int):
+    def _whole_task_batches(self, records, mb: int, feed):
         """Device minibatches for a task from ONE decode + ONE transfer (see
         _dispatch_training_task).  A ragged tail still goes through the
         wrap-padded host path — at most one per task."""
@@ -431,8 +443,8 @@ class Worker:
         for i in range(n_full):
             yield jax.tree.map(lambda v: v[i * mb : (i + 1) * mb], big)
         if len(records) % mb:
-            for chunk, _ in _minibatches(records[n_full * mb :], mb, True):
-                yield self.trainer.shard_batch(self.spec.feed(chunk))
+            for chunk, true_count in _minibatches(records[n_full * mb :], mb, True):
+                yield self.trainer.shard_batch(feed(chunk, true_count))
 
     def _finalize_training_metrics(self, metrics_list) -> Dict[str, float]:
         """ONE device_get of the whole task's per-batch metrics, then host

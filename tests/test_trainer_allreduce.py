@@ -77,3 +77,67 @@ def test_eval_step(spec, devices):
     batch = trainer.shard_batch(_batch(jax.random.key(4)))
     metrics = trainer.eval_step(state, batch)
     assert set(metrics) >= {"accuracy", "loss"}
+
+
+def test_masked_train_tail_matches_unpadded(spec, devices):
+    """A wrap-padded training tail with ``__mask__`` must produce EXACTLY the
+    update of the true partial batch: padded duplicates carry zero gradient
+    (VERDICT r3 item 4 — eval got the mask in r3, training gets it here)."""
+    real, padded_size = 10, 16
+    b = _batch(jax.random.key(7), n=real)
+    # Wrap-pad like worker._minibatches: records repeat cyclically.
+    idx = np.arange(padded_size) % real
+    padded = {k: np.asarray(v)[idx] for k, v in b.items()}
+    padded["__mask__"] = (np.arange(padded_size) < real).astype(np.float32)
+
+    mesh = create_mesh(devices[:1])
+    trainer_m = Trainer(spec, JobConfig(), mesh)
+    state = trainer_m.init_state(jax.random.key(0))
+    host_state = jax.device_get(state)  # before the step donates its buffers
+    masked_state, masked_metrics = trainer_m.train_step(
+        state, trainer_m.shard_batch(padded)
+    )
+
+    trainer_t = Trainer(spec, JobConfig(), mesh)
+    state_t = trainer_t.shard_state(host_state)
+    truth_state, truth_metrics = trainer_t.train_step(
+        state_t,
+        trainer_t.shard_batch({k: np.asarray(v) for k, v in b.items()}),
+    )
+
+    assert abs(
+        float(masked_metrics["loss"]) - float(truth_metrics["loss"])
+    ) < 1e-6
+    for a, t in zip(
+        jax.tree.leaves(jax.device_get(masked_state.params)),
+        jax.tree.leaves(jax.device_get(truth_state.params)),
+    ):
+        np.testing.assert_allclose(a, t, rtol=1e-5, atol=1e-6)
+
+
+def test_masked_tail_differs_from_unmasked_padding(spec, devices):
+    """Without the mask the duplicated examples double-count (the r3 bug);
+    this pins that the mask actually changes the update."""
+    real, padded_size = 10, 16
+    b = _batch(jax.random.key(8), n=real)
+    idx = np.arange(padded_size) % real
+    padded = {k: np.asarray(v)[idx] for k, v in b.items()}
+    mesh = create_mesh(devices[:1])
+    trainer = Trainer(spec, JobConfig(), mesh)
+    state = trainer.init_state(jax.random.key(0))
+    host_state = jax.device_get(state)
+    unmasked_state, _ = trainer.train_step(state, trainer.shard_batch(padded))
+
+    masked = dict(padded)
+    masked["__mask__"] = (np.arange(padded_size) < real).astype(np.float32)
+    trainer2 = Trainer(spec, JobConfig(), mesh)
+    state2 = trainer2.shard_state(host_state)
+    masked_state, _ = trainer2.train_step(state2, trainer2.shard_batch(masked))
+    diffs = [
+        np.max(np.abs(np.asarray(a) - np.asarray(t)))
+        for a, t in zip(
+            jax.tree.leaves(jax.device_get(masked_state.params)),
+            jax.tree.leaves(jax.device_get(unmasked_state.params)),
+        )
+    ]
+    assert max(diffs) > 1e-7
