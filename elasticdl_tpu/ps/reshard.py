@@ -91,8 +91,8 @@ def write_snapshot(path: str, header: dict, ids, adam_t, rows) -> None:
     os.replace(tmp, path)
 
 
-def _tables_in(step_dir: str) -> Dict[str, Dict[int, str]]:
-    """{table_key: {shard_index: path}} for one step dir.
+def _tables_in(step_dir: str) -> Dict[str, Tuple[int, Dict[int, str]]]:
+    """{table_key: (fleet_size, {shard_index: path})} for one step dir.
 
     Grouped by (key, fleet size) internally and REFUSING mixed shardings of
     the same table: without ``--prune-old`` a previous reshard leaves both

@@ -223,7 +223,7 @@ class Worker:
                 f"membership v{version}: world changed to {world} hosts"
             )
         n_dev = self._mesh_size(world)
-        dcn = getattr(self.config, "dcn_data_parallelism", 1)
+        dcn = self.config.dcn_data_parallelism
         if dcn > 1 and n_dev % dcn != 0:
             # Training availability beats layout: an elastic resize can land
             # on a device count the configured hierarchy no longer divides
