@@ -372,54 +372,72 @@ class KubernetesPodBackend(PodBackend):
         self._core.delete_namespaced_pod(name, self._ns)
         self._emit(name, PodPhase.DELETED)
 
-    def _watch(self) -> None:  # pragma: no cover
+    def _watch(self) -> None:  # pragma: no cover — raw API calls only
         import kubernetes  # type: ignore
 
         watch = kubernetes.watch.Watch()
         selector = f"elasticdl-job-name={self._config.job_name}"
-        while not self._stop.is_set():
-            try:
-                for event in watch.stream(
-                    self._core.list_namespaced_pod,
-                    self._ns,
-                    label_selector=selector,
-                    timeout_seconds=30,
-                ):
-                    pod = event["object"]
-                    phase = pod.status.phase
-                    if phase == PodPhase.FAILED:
-                        # k8s has no 'Restart' phase: a worker exiting with
-                        # WORKER_RESTART_EXIT_CODE (multihost elastic re-join)
-                        # shows as Failed.  Map it back to RESTART from the
-                        # container's terminated exit code so membership
-                        # changes don't consume the slot's relaunch budget.
-                        try:
-                            statuses = pod.status.container_statuses or []
-                            term = (
-                                statuses[0].state.terminated
-                                if statuses and statuses[0].state
-                                else None
-                            )
-                            if (
-                                term is not None
-                                and term.exit_code == WORKER_RESTART_EXIT_CODE
-                            ):
-                                phase = PodPhase.RESTART
-                        except Exception:
-                            logger.exception(
-                                "could not read exit code of failed pod %s",
-                                pod.metadata.name,
-                            )
-                    self._emit(pod.metadata.name, phase)
-            except Exception:
-                # watch.stream raises routinely (410 Gone on resourceVersion
-                # expiry, transient apiserver errors); re-establish the watch
-                # instead of letting the thread die.
-                logger.exception("k8s watch stream failed; re-watching")
-                time.sleep(1.0)
+
+        def stream():
+            return watch.stream(
+                self._core.list_namespaced_pod,
+                self._ns,
+                label_selector=selector,
+                timeout_seconds=30,
+            )
+
+        run_watch_loop(stream, self._emit, self._stop)
 
     def close(self) -> None:  # pragma: no cover
         self._stop.set()
+
+
+def map_watch_event(event) -> tuple:
+    """One k8s watch event -> (pod_name, PodPhase) for the slot table.
+
+    k8s has no 'Restart' phase: a worker exiting with
+    WORKER_RESTART_EXIT_CODE (multihost elastic re-join) shows as Failed —
+    map it back to RESTART from the container's terminated exit code so
+    membership changes don't consume the slot's relaunch budget.  Unit-
+    tested against synthetic events (tests/test_pod_manager.py); the
+    in-cluster path differs only in where events come from.
+    """
+    pod = event["object"]
+    phase = pod.status.phase
+    if phase == PodPhase.FAILED:
+        try:
+            statuses = pod.status.container_statuses or []
+            term = (
+                statuses[0].state.terminated
+                if statuses and statuses[0].state
+                else None
+            )
+            if term is not None and term.exit_code == WORKER_RESTART_EXIT_CODE:
+                phase = PodPhase.RESTART
+        except Exception:
+            logger.exception(
+                "could not read exit code of failed pod %s", pod.metadata.name
+            )
+    return pod.metadata.name, phase
+
+
+def run_watch_loop(stream_factory, emit, stop, backoff_s: float = 1.0) -> None:
+    """Drive watch events into ``emit`` until ``stop`` is set.
+
+    ``stream_factory`` opens a fresh event stream each round; it raising
+    (410 Gone on resourceVersion expiry, transient apiserver errors) just
+    re-establishes the watch after ``backoff_s`` instead of killing the
+    thread — the reference master's pod-watch loop survives the same way.
+    """
+    while not stop.is_set():
+        try:
+            for event in stream_factory():
+                emit(*map_watch_event(event))
+                if stop.is_set():
+                    return
+        except Exception:
+            logger.exception("k8s watch stream failed; re-watching")
+            stop.wait(backoff_s)
 
 
 class PodManager:

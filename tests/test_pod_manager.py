@@ -250,3 +250,95 @@ class TestMasterProcessJob:
             os.environ.pop("CRASH_MARKER", None)
         assert os.path.exists(marker)  # the crash really happened
         assert status["finished"] and status["done"] == 4
+
+
+# ---------------------------------------------------------------------------
+# k8s watch-event mapping (VERDICT r3 item 8): synthetic events through the
+# same mapping/loop the in-cluster watcher drives, no cluster needed.
+# ---------------------------------------------------------------------------
+
+
+def _fake_pod(name, phase, exit_code=None, broken=False):
+    from types import SimpleNamespace as NS
+
+    if broken:
+        status = NS(phase=phase, container_statuses=[NS(state=None)])
+        # make attribute access explode like a half-populated API object
+        class Boom:
+            @property
+            def container_statuses(self):
+                raise AttributeError("partial API object")
+
+            phase = PodPhase.FAILED
+        status = Boom()
+    elif exit_code is None:
+        status = NS(phase=phase, container_statuses=None)
+    else:
+        status = NS(
+            phase=phase,
+            container_statuses=[NS(state=NS(terminated=NS(exit_code=exit_code)))],
+        )
+    return {"object": NS(metadata=NS(name=name), status=status)}
+
+
+def test_map_watch_event_phases():
+    from elasticdl_tpu.master.pod_manager import (
+        WORKER_RESTART_EXIT_CODE,
+        map_watch_event,
+    )
+
+    assert map_watch_event(_fake_pod("w0", "Running")) == ("w0", PodPhase.RUNNING)
+    assert map_watch_event(_fake_pod("w0", "Succeeded")) == (
+        "w0", PodPhase.SUCCEEDED,
+    )
+    # Failed + RESTART exit code -> budget-free RESTART
+    assert map_watch_event(
+        _fake_pod("w1", "Failed", exit_code=WORKER_RESTART_EXIT_CODE)
+    ) == ("w1", PodPhase.RESTART)
+    # Failed + real failure exit code -> FAILED (consumes relaunch budget)
+    assert map_watch_event(_fake_pod("w2", "Failed", exit_code=1)) == (
+        "w2", PodPhase.FAILED,
+    )
+    # Failed with no container statuses -> FAILED
+    assert map_watch_event(_fake_pod("w3", "Failed")) == ("w3", PodPhase.FAILED)
+    # Half-populated API object: mapping must not raise, stays FAILED
+    assert map_watch_event(_fake_pod("w4", "Failed", broken=True)) == (
+        "w4", PodPhase.FAILED,
+    )
+
+
+def test_run_watch_loop_reestablishes_and_feeds_slots():
+    """The loop survives a stream that dies mid-watch (410 Gone analogue)
+    and keeps emitting; RESTART events reach the PodManager relaunch logic
+    without consuming the failure budget (wired end-to-end elsewhere via
+    FakePodBackend — here we pin the k8s-side mapping feeding _emit)."""
+    import threading
+
+    from elasticdl_tpu.master.pod_manager import (
+        WORKER_RESTART_EXIT_CODE,
+        run_watch_loop,
+    )
+
+    stop = threading.Event()
+    seen = []
+    rounds = []
+
+    def stream_factory():
+        rounds.append(1)
+        if len(rounds) == 1:
+            def first():
+                yield _fake_pod("w0", "Running")
+                raise RuntimeError("410 Gone")
+            return first()
+
+        def second():
+            yield _fake_pod("w0", "Failed", exit_code=WORKER_RESTART_EXIT_CODE)
+            stop.set()
+            yield _fake_pod("w9", "Running")  # consumed; loop exits after
+        return second()
+
+    run_watch_loop(stream_factory, lambda n, p: seen.append((n, p)), stop,
+                   backoff_s=0.01)
+    assert ("w0", PodPhase.RUNNING) in seen
+    assert ("w0", PodPhase.RESTART) in seen
+    assert len(rounds) == 2
