@@ -47,6 +47,13 @@ class DistributedSpec:
     coordinator_address: str  # host:port of process 0's coordination service
     num_processes: int
     process_id: int
+    # Coordination-service peer-death detection.  JAX's default (100 s)
+    # dominates elastic recovery: a survivor blocked inside a collective on
+    # a dead peer sits there until THIS timeout aborts it (measured 83 s of
+    # a 99 s total re-rendezvous — tools/rendezvous_bench.py).  10 s trades
+    # a little heartbeat traffic for ~9x faster failure detection; raise it
+    # on networks where 10 s of silence is normal.
+    heartbeat_timeout_s: int = 10
 
     @property
     def enabled(self) -> bool:
@@ -89,6 +96,7 @@ def initialize(spec: DistributedSpec) -> None:
         coordinator_address=spec.coordinator_address,
         num_processes=spec.num_processes,
         process_id=spec.process_id,
+        heartbeat_timeout_seconds=spec.heartbeat_timeout_s,
     )
     _ACTIVE = spec
 
