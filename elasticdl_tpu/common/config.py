@@ -94,6 +94,13 @@ class JobConfig:
     # tables are affected: mesh-sharded tables and dense params live inside
     # the jitted step and are always exact.
     use_async: bool = False
+    # Staleness bound for --use_async: up to this many steps' host-tier
+    # pushes may be outstanding when a pull happens (1 = the classic
+    # async-PS window).  Deeper bounds hide more host RPC latency behind
+    # device steps at the cost of staler rows; tools/async_depth_bench.py
+    # measures the trade (table in docs/perf.md) and the default follows
+    # that data.
+    async_staleness: int = 1
     # host:port list of the PS shards, comma-separated, in shard order.  Set
     # by the master onto the worker pod env; settable by hand to point
     # workers at an externally managed PS fleet.
@@ -173,6 +180,8 @@ class JobConfig:
             raise ValueError("--num_ps_pods cannot be negative")
         if self.prefetch_depth < 0:
             raise ValueError("--prefetch_depth cannot be negative")
+        if self.async_staleness < 1:
+            raise ValueError("--async_staleness must be >= 1")
         if self.dcn_data_parallelism < 1:
             raise ValueError("--dcn_data_parallelism must be >= 1")
         # Kept in sync with ops.embedding.LOOKUP_IMPLS (asserted by tests);

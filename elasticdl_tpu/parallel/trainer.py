@@ -571,18 +571,26 @@ class Trainer:
                 state, metrics = self.run_train_step(state, batch)
                 metrics_out.append(metrics)
             return state, metrics_out
-        pending = None  # (ids, host_grads) of the in-flight step
+        # Staleness bound D = config.async_staleness: up to D steps' pushes
+        # may be outstanding when a pull happens, letting D host-tier RPC
+        # round-trips hide behind device steps (depth 1 = the reference's
+        # classic async-PS window; deeper bounds measured by
+        # tools/async_depth_bench.py — the default is chosen by that data).
+        from collections import deque
+
+        depth = self.config.async_staleness
+        pending: deque = deque()  # (ids, host_grads) of in-flight steps
         for batch in batches:
             injected, ids = self._inject_host_rows(batch)
-            if pending is not None:
-                self._push_host_grads(*pending)
+            while len(pending) >= depth:
+                self._push_host_grads(*pending.popleft())
             state, metrics, host_grads = self.train_step(
                 state, self.shard_batch(injected)
             )
-            pending = (ids, host_grads)
+            pending.append((ids, host_grads))
             metrics_out.append(metrics)
-        if pending is not None:
-            self._push_host_grads(*pending)
+        while pending:
+            self._push_host_grads(*pending.popleft())
         return state, metrics_out
 
     def run_eval_step(self, state: TrainState, batch: Any):

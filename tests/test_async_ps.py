@@ -138,3 +138,52 @@ def test_worker_task_uses_async_driver(devices, monkeypatch):
     metrics = worker._run_training_task(task)
     assert seen["use_async"] is True
     assert np.isfinite(metrics["loss"])
+
+
+@needs_native
+def test_async_depth_parameter(devices):
+    """--async_staleness D: pulls may see up to D un-applied pushes, but
+    every push still lands by the end of the run; depth 1 reproduces the
+    r3 behavior exactly."""
+    import jax
+
+    spec = _spec()
+    for depth in (1, 2, 4, 8):  # 8 > n_batches: everything drains at end
+        trainer = Trainer(
+            spec,
+            JobConfig(
+                distribution_strategy=DistributionStrategy.PARAMETER_SERVER,
+                async_staleness=depth,
+            ),
+            create_mesh(devices[:4]),
+        )
+        pushes = []
+        orig = trainer._push_host_grads
+        trainer._push_host_grads = lambda *a: (pushes.append(1), orig(*a))[1]
+        state = trainer.init_state(jax.random.key(0))
+        state, metrics = trainer.run_train_steps(
+            state, _batches(5), use_async=True
+        )
+        assert len(pushes) == 5, f"depth {depth}: every push must land"
+        assert all(np.isfinite(float(m["loss"])) for m in metrics)
+
+    # depth 1 == the old pipeline bit-for-bit
+    l1, r1 = _run(devices, use_async=True, n_batches=4)
+    trainer = Trainer(
+        spec,
+        JobConfig(
+            distribution_strategy=DistributionStrategy.PARAMETER_SERVER,
+            async_staleness=1,
+        ),
+        create_mesh(devices[:4]),
+    )
+    state = trainer.init_state(jax.random.key(0))
+    state, metrics = trainer.run_train_steps(
+        state, _batches(4), use_async=True
+    )
+    key = list(spec.host_io)[0]
+    probe = np.arange(64, dtype=np.int64)
+    np.testing.assert_array_equal(
+        trainer._host_stores[key].pull(probe), r1
+    )
+    assert [float(m["loss"]) for m in metrics] == l1
