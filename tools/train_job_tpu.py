@@ -1,0 +1,128 @@
+"""One full `elasticdl train` job on the real TPU (VERDICT r3 item 3).
+
+The whole SURVEY §3.1-3.3 stack, on hardware, once: an embedded Master
+(gRPC servicer + TaskDispatcher + RendezvousServer + PodManager — the
+master itself never touches jax) launches a REAL worker process via
+ProcessPodBackend; the worker grabs the chip, reads criteo recordio shards
+through the C++ bulk reader, decodes with the C++ pre-processing codec,
+and trains hybrid DeepFM with periodic checkpoints until the dispatcher
+drains.  The tool polls JobStatus to timestamp task completions and writes
+a committed artifact (TRAINJOB_r04.json) with wall-clock and end-to-end
+examples/sec/chip.
+
+Usage: python tools/train_job_tpu.py [--epochs 16] [--out TRAINJOB_r04.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.bench_e2e import (  # noqa: E402
+    MINIBATCH,
+    MINIBATCHES_PER_TASK,
+    RECORDS_PER_TASK,
+    _dataset,
+)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=16)
+    ap.add_argument("--out", default="TRAINJOB_r04.json")
+    args = ap.parse_args()
+
+    import tempfile
+
+    from elasticdl_tpu.common.config import DistributionStrategy, JobConfig
+    from elasticdl_tpu.master.main import Master
+
+    path = _dataset()
+    ckpt = tempfile.mkdtemp(prefix="trainjob_ckpt_")
+    config = JobConfig(
+        job_name="trainjob-tpu",
+        model_def="deepfm.model_spec",
+        model_params="buckets_per_feature=65536;embedding_dim=8;hidden=[400,400]",
+        distribution_strategy=DistributionStrategy.PARAMETER_SERVER,
+        training_data=path,
+        minibatch_size=MINIBATCH,
+        num_minibatches_per_task=MINIBATCHES_PER_TASK,
+        num_epochs=args.epochs,
+        num_workers=1,
+        pod_backend="process",
+        checkpoint_dir=ckpt,
+        checkpoint_steps=64,
+    )
+    master = Master(config)
+    status_box: dict = {}
+
+    def run_master():
+        try:
+            status_box["status"] = master.run()
+        except Exception as e:  # noqa: BLE001
+            status_box["error"] = repr(e)
+
+    t_start = time.time()
+    thread = threading.Thread(target=run_master, daemon=True)
+    thread.start()
+
+    timeline = []  # (t, done_count)
+    last = -1
+    while thread.is_alive():
+        try:
+            done = master.servicer.JobStatus({})["done"]
+        except Exception:
+            done = last
+        if done != last:
+            timeline.append((time.time(), done))
+            last = done
+            print(f"[job] {done} tasks done at +{time.time() - t_start:.1f}s",
+                  file=sys.stderr, flush=True)
+        time.sleep(0.2)
+    thread.join()
+    t_total = time.time() - t_start
+    if "error" in status_box:
+        raise SystemExit(f"master failed: {status_box['error']}")
+    status = status_box["status"]
+
+    # Steady-state e2e throughput: exclude the first 2 tasks (worker boot +
+    # XLA compile); measure task 2 -> last.
+    warm = 2
+    steady = [(t, d) for t, d in timeline if d >= warm]
+    if len(steady) >= 2:
+        (t0, d0), (t1, d1) = steady[0], steady[-1]
+        eps = (d1 - d0) * RECORDS_PER_TASK / max(t1 - t0, 1e-9)
+    else:
+        eps = None
+
+    ckpt_steps = sorted(
+        int(s) for s in os.listdir(ckpt) if s.isdigit()
+    ) if os.path.isdir(ckpt) else []
+    result = {
+        "metric": "full_train_job_e2e_examples_per_sec_per_chip",
+        "value": round(eps) if eps else None,
+        "unit": "examples/sec/chip",
+        "job_status": {k: v for k, v in status.items() if k != "eval_metrics"},
+        "wall_total_s": round(t_total, 1),
+        "tasks": timeline[-1][1] if timeline else 0,
+        "records_per_task": RECORDS_PER_TASK,
+        "warm_tasks_excluded": warm,
+        "checkpoint_steps_on_disk": ckpt_steps,
+        "stack": "Master(gRPC)+ProcessPodBackend worker on TPU, recordio "
+                 "input via C++ bulk reader + preprocessing codec, "
+                 "periodic+final checkpoints",
+    }
+    print(json.dumps(result), flush=True)
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(f"[job] artifact written to {args.out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
