@@ -62,6 +62,34 @@ class TrainState(struct.PyTreeNode):
     opt_state: Any
 
 
+class TrainLoopError(RuntimeError):
+    """A step failed mid-run of ``run_train_steps``.
+
+    The jitted step DONATES its input state, so after a failure neither the
+    caller's original state nor (possibly) the failing call's input still
+    backs real buffers.  ``state`` carries the newest state whose buffers
+    are verifiably alive (the last successful step's output), or None when
+    nothing usable survives — the worker then rebuilds from the checkpoint
+    instead of retrying tasks against deleted buffers forever (the pre-r4
+    failure mode: one failed step wedged every subsequent task)."""
+
+    def __init__(self, state: Optional["TrainState"], cause: BaseException):
+        super().__init__(str(cause))
+        self.state = state
+
+
+def _state_alive(state: Optional["TrainState"]) -> bool:
+    if state is None:
+        return False
+    try:
+        return not any(
+            getattr(leaf, "is_deleted", lambda: False)()
+            for leaf in jax.tree.leaves(state)
+        )
+    except Exception:  # pragma: no cover - defensive
+        return False
+
+
 def _process_count(mesh: Mesh) -> int:
     """Distinct host processes owning this mesh's devices (1 = single-host)."""
     return len({d.process_index for d in mesh.devices.flat})
@@ -195,6 +223,11 @@ class Trainer:
         )
         self.ctx = self._make_ctx()
         self._state_specs = None
+        # Per-batch-structure step caches (see _structured); _train_step
+        # keeps pointing at the most recently used build (profiling tools).
+        self._train_steps: Dict = {}
+        self._eval_steps: Dict = {}
+        self._predict_steps: Dict = {}
         self._train_step = None
         self._eval_step = None
         self._predict_step = None
@@ -316,6 +349,9 @@ class Trainer:
         self._adopt_mesh_axes(mesh)
         self.ctx = self._make_ctx()
         self._state_specs = None
+        self._train_steps = {}
+        self._eval_steps = {}
+        self._predict_steps = {}
         self._train_step = None
         self._eval_step = None
         self._predict_step = None
@@ -555,43 +591,51 @@ class Trainer:
 
         Returns (state, [metrics per batch]).
         """
-        metrics_out = []
-        if pre_sharded:
-            if self.spec.host_io:
-                raise ValueError(
-                    "pre_sharded batches are incompatible with host-tier "
-                    "tables (the host pull needs the host batch)"
-                )
-            for batch in batches:
-                state, metrics = self.train_step(state, batch)
-                metrics_out.append(metrics)
-            return state, metrics_out
-        if not self.spec.host_io or not use_async:
-            for batch in batches:
-                state, metrics = self.run_train_step(state, batch)
-                metrics_out.append(metrics)
-            return state, metrics_out
-        # Staleness bound D = config.async_staleness: up to D steps' pushes
-        # may be outstanding when a pull happens, letting D host-tier RPC
-        # round-trips hide behind device steps (depth 1 = the reference's
-        # classic async-PS window; deeper bounds measured by
-        # tools/async_depth_bench.py — the default is chosen by that data).
-        from collections import deque
-
-        depth = self.config.async_staleness
-        pending: deque = deque()  # (ids, host_grads) of in-flight steps
-        for batch in batches:
-            injected, ids = self._inject_host_rows(batch)
-            while len(pending) >= depth:
-                self._push_host_grads(*pending.popleft())
-            state, metrics, host_grads = self.train_step(
-                state, self.shard_batch(injected)
+        if pre_sharded and self.spec.host_io:
+            raise ValueError(
+                "pre_sharded batches are incompatible with host-tier "
+                "tables (the host pull needs the host batch)"
             )
-            pending.append((ids, host_grads))
-            metrics_out.append(metrics)
-        while pending:
-            self._push_host_grads(*pending.popleft())
-        return state, metrics_out
+        metrics_out = []
+        last_good: Optional[TrainState] = None  # newest verified-alive state
+        try:
+            if pre_sharded or not self.spec.host_io or not use_async:
+                step = self.train_step if pre_sharded else self.run_train_step
+                for batch in batches:
+                    state, metrics = step(state, batch)
+                    metrics_out.append(metrics)
+                    last_good = state
+                return state, metrics_out
+            # Staleness bound D = config.async_staleness: up to D steps'
+            # pushes may be outstanding when a pull happens, letting D
+            # host-tier RPC round-trips hide behind device steps (depth 1 =
+            # the reference's classic async-PS window; deeper bounds
+            # measured by tools/async_depth_bench.py — the default is
+            # chosen by that data).
+            from collections import deque
+
+            depth = self.config.async_staleness
+            pending: deque = deque()  # (ids, host_grads) of in-flight steps
+            for batch in batches:
+                injected, ids = self._inject_host_rows(batch)
+                while len(pending) >= depth:
+                    self._push_host_grads(*pending.popleft())
+                state, metrics, host_grads = self.train_step(
+                    state, self.shard_batch(injected)
+                )
+                pending.append((ids, host_grads))
+                metrics_out.append(metrics)
+                last_good = state
+            while pending:
+                self._push_host_grads(*pending.popleft())
+            return state, metrics_out
+        except Exception as e:
+            # The failed call may have consumed (donated) its input state;
+            # surface the newest state that still backs live buffers so the
+            # caller can continue instead of wedging on deleted arrays.
+            raise TrainLoopError(
+                last_good if _state_alive(last_good) else None, e
+            ) from e
 
     def run_eval_step(self, state: TrainState, batch: Any):
         if self.spec.host_io:
@@ -733,41 +777,46 @@ class Trainer:
 
     # ---- step builders ----
 
-    def train_step(self, state: TrainState, batch: Any):
-        if self._train_step is None:
-            self._train_step = build_train_step(
+    # Built steps cache by the BATCH TREE STRUCTURE, not just lazily once:
+    # shard_map in_specs are a structural prefix of the batch, and batches
+    # of one job legitimately differ in structure (a wrap-padded tail adds
+    # ``__mask__``).  A single cached step built from the first batch then
+    # blows up on the tail's pytree (found by test_partial_tail_batch).
+    # jit still handles shape/dtype retraces within a structure.
+
+    def _structured(self, cache: Dict, build, batch: Any, **kwargs):
+        key = jax.tree.structure(batch)
+        fn = cache.get(key)
+        if fn is None:
+            fn = build(
                 self.spec,
                 self.mesh,
                 self.ctx,
                 self.state_specs(),
-                host_keys=tuple(sorted(self.spec.host_io)),
                 batch_specs=self.batch_specs(batch),
                 batch_axes=self.batch_axes,
+                **kwargs,
             )
+            cache[key] = fn
+        return fn
+
+    def train_step(self, state: TrainState, batch: Any):
+        self._train_step = self._structured(
+            self._train_steps, build_train_step, batch,
+            host_keys=tuple(sorted(self.spec.host_io)),
+        )
         return self._train_step(state, batch)
 
     def eval_step(self, state: TrainState, batch: Any) -> Dict[str, jax.Array]:
-        if self._eval_step is None:
-            self._eval_step = build_eval_step(
-                self.spec,
-                self.mesh,
-                self.ctx,
-                self.state_specs(),
-                batch_specs=self.batch_specs(batch),
-                batch_axes=self.batch_axes,
-            )
+        self._eval_step = self._structured(
+            self._eval_steps, build_eval_step, batch
+        )
         return self._eval_step(state, batch)
 
     def predict_step(self, state: TrainState, batch: Any):
-        if self._predict_step is None:
-            self._predict_step = build_predict_step(
-                self.spec,
-                self.mesh,
-                self.ctx,
-                self.state_specs(),
-                batch_specs=self.batch_specs(batch),
-                batch_axes=self.batch_axes,
-            )
+        self._predict_step = self._structured(
+            self._predict_steps, build_predict_step, batch
+        )
         return self._predict_step(state, batch)
 
 

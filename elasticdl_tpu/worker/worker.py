@@ -36,7 +36,7 @@ from elasticdl_tpu.master.task_dispatcher import (
 )
 from elasticdl_tpu.models.spec import ModelSpec, load_model_spec_for_job
 from elasticdl_tpu.parallel.mesh import create_mesh
-from elasticdl_tpu.parallel.trainer import Trainer
+from elasticdl_tpu.parallel.trainer import Trainer, TrainLoopError
 
 logger = get_logger("worker")
 
@@ -418,12 +418,23 @@ class Worker:
         # (-> sparse push) per batch; plain shard+step when no host tables.
         # --use_async pipelines the host-tier pulls against the device step
         # (the reference's async-PS mode — bounded staleness 1).
-        self.state, metrics_list = self.trainer.run_train_steps(
-            self.state,
-            batches,
-            use_async=self.config.use_async,
-            pre_sharded=pre_shard,
-        )
+        try:
+            self.state, metrics_list = self.trainer.run_train_steps(
+                self.state,
+                batches,
+                use_async=self.config.use_async,
+                pre_sharded=pre_shard,
+            )
+        except TrainLoopError as e:
+            # The failed step may have consumed (donated) the state this
+            # worker still references; adopt the newest live state — or
+            # rebuild from the checkpoint — so the requeued task retries
+            # against real buffers instead of wedging every later task.
+            if e.state is not None:
+                self.state = e.state
+            else:
+                self._recover_state()
+            raise
         # Start the D2H copy of the task's metrics NOW, in the background:
         # the runtime moves each value to the host as soon as its step
         # completes, so the deferred fetch in _finalize_training_metrics
@@ -433,6 +444,29 @@ class Worker:
             if hasattr(leaf, "copy_to_host_async"):
                 leaf.copy_to_host_async()
         return metrics_list, n_steps
+
+    def _recover_state(self) -> None:
+        """Rebuild training state after a failed step consumed the live
+        buffers: newest restorable checkpoint if any, else fresh init
+        (loudly — a training job loses at most the work since the last
+        checkpoint; the failed task is requeued either way)."""
+        logger.error(
+            "training state lost to a failed step; rebuilding from checkpoint"
+        )
+        self.state = self.trainer.init_state(jax.random.key(0))
+        steps = self._ckpt.all_steps() if self._ckpt is not None else []
+        for step in steps:
+            try:
+                restored = self._ckpt.restore(self.state, step=step)
+                self.trainer.restore_host_stores(self._ckpt.directory, step)
+                self.state = restored
+                logger.info("recovered from checkpoint step %d", step)
+                return
+            except FileNotFoundError:
+                continue
+        logger.error(
+            "no restorable checkpoint; training state re-initialized fresh"
+        )
 
     def _whole_task_batches(self, records, mb: int, feed):
         """Device minibatches for a task from ONE decode + ONE transfer (see

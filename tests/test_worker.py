@@ -216,3 +216,40 @@ def test_eval_job_without_restorable_checkpoint_fails_loud(tmp_path, devices):
     )
     with pytest.raises(RuntimeError, match="no restorable checkpoint"):
         worker.run()
+
+
+def test_failed_step_recovers_state(tmp_path, devices):
+    """A step failure mid-task must not leave the worker holding donated
+    buffers: it adopts the last-good state from TrainLoopError (or rebuilds
+    from checkpoint), the task is reported failed + requeued, and the job
+    still completes (r4 regression: one bad step used to wedge every
+    subsequent task on deleted arrays)."""
+    from elasticdl_tpu.parallel.trainer import Trainer
+
+    config, servicer, reader, eval_reader, spec = _mnist_job(tmp_path)
+
+    orig = Trainer.train_step
+    fail = {"armed": True}
+
+    def flaky(self, state, batch):
+        state, metrics = orig(self, state, batch)
+        if fail["armed"]:
+            fail["armed"] = False
+            # the input state was donated by the call above; a failure NOW
+            # mimics a step crash after consumption
+            raise RuntimeError("injected step failure")
+        return state, metrics
+
+    Trainer.train_step = flaky
+    try:
+        worker = Worker(
+            config, DirectMasterProxy(servicer), reader,
+            worker_id="w0", spec=spec, devices=devices,
+        )
+        result = worker.run()
+    finally:
+        Trainer.train_step = orig
+    assert servicer.dispatcher.finished()
+    assert result["step"] >= 6  # all shards trained (failed task re-run)
+    status = servicer.JobStatus({})
+    assert status["done"] == 3 and status["todo"] == 0
