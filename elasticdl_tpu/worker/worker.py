@@ -392,39 +392,60 @@ class Worker:
                 )
             return batch
 
-        if pre_shard and self.config.prefetch_depth > 0 and len(records) >= mb:
-            # Whole-task batch prep: ONE feed call over every full minibatch
-            # and ONE H2D transfer, then per-step device-side slices.  On a
-            # single-core host the per-batch producer thread loses a GIL
-            # fight with the dispatch loop (measured: 2.5 ms standalone
-            # decode inflating to 7+ ms under contention); one big decode
-            # amortizes that to nothing, and the task-level pipeline in
-            # ``run`` overlaps this host work with the PREVIOUS task's
-            # device steps.  Slices along the already-sharded batch dim are
-            # shard-local (minibatch divisibility is enforced by
-            # shard_batch), so each step's inputs cost three tiny async
-            # dispatches instead of host work.
-            batches = self._whole_task_batches(records, mb, _train_feed)
-        else:
-            def _gen():
-                for chunk, true_count in _minibatches(records, mb, True):
-                    batch = _train_feed(chunk, true_count)
-                    yield (
-                        self.trainer.shard_batch(batch) if pre_shard else batch
-                    )
-
-            batches = prefetch(_gen(), self.config.prefetch_depth)
-        # run_train_steps = (host-tier pull ->) shard -> jitted step
-        # (-> sparse push) per batch; plain shard+step when no host tables.
-        # --use_async pipelines the host-tier pulls against the device step
-        # (the reference's async-PS mode — bounded staleness 1).
+        n_full = len(records) // mb
         try:
-            self.state, metrics_list = self.trainer.run_train_steps(
-                self.state,
-                batches,
-                use_async=self.config.use_async,
-                pre_sharded=pre_shard,
-            )
+            if pre_shard and self.config.prefetch_depth > 0 and n_full >= 1:
+                # Whole-task fused path: ONE feed call over every full
+                # minibatch, ONE H2D transfer of the stacked [T, mb, ...]
+                # batch, and ONE jitted lax.scan running all T steps — one
+                # dispatch per task (per-step dispatch costs ~half the step
+                # wall-clock on a remote-attached chip, and a single big
+                # decode also sidesteps the GIL fight a per-batch producer
+                # thread loses on 1-core hosts; docs/perf.md).  The
+                # task-level pipeline in ``run`` overlaps this host work
+                # with the PREVIOUS task's scan.  A ragged tail trains as
+                # one extra masked step.
+                big = self.spec.feed(records[: n_full * mb])
+                stacked = jax.tree.map(
+                    lambda v: np.ascontiguousarray(v).reshape(
+                        (n_full, mb) + v.shape[1:]
+                    ),
+                    dict(big),
+                )
+                self.state, scan_metrics = self.trainer.train_scan(
+                    self.state, self.trainer.shard_stacked_batch(stacked)
+                )
+                metrics_list = [scan_metrics]  # [T]-stacked dict
+                for chunk, true_count in _minibatches(
+                    records[n_full * mb :], mb, True
+                ):
+                    self.state, m = self.trainer.train_step(
+                        self.state,
+                        self.trainer.shard_batch(
+                            _train_feed(chunk, true_count)
+                        ),
+                    )
+                    metrics_list.append(m)
+            else:
+                def _gen():
+                    for chunk, true_count in _minibatches(records, mb, True):
+                        batch = _train_feed(chunk, true_count)
+                        yield (
+                            self.trainer.shard_batch(batch)
+                            if pre_shard
+                            else batch
+                        )
+
+                # run_train_steps = (host-tier pull ->) shard -> jitted step
+                # (-> sparse push) per batch; plain shard+step when no host
+                # tables.  --use_async pipelines the host-tier pulls against
+                # the device step (the reference's async-PS mode).
+                self.state, metrics_list = self.trainer.run_train_steps(
+                    self.state,
+                    prefetch(_gen(), self.config.prefetch_depth),
+                    use_async=self.config.use_async,
+                    pre_sharded=pre_shard,
+                )
         except TrainLoopError as e:
             # The failed step may have consumed (donated) the state this
             # worker still references; adopt the newest live state — or
@@ -433,6 +454,13 @@ class Worker:
             if e.state is not None:
                 self.state = e.state
             else:
+                self._recover_state()
+            raise
+        except Exception:
+            from elasticdl_tpu.parallel.trainer import _state_alive
+
+            # Same donated-state hazard for the fused path's direct calls.
+            if not _state_alive(self.state):
                 self._recover_state()
             raise
         # Start the D2H copy of the task's metrics NOW, in the background:
@@ -468,30 +496,26 @@ class Worker:
             "no restorable checkpoint; training state re-initialized fresh"
         )
 
-    def _whole_task_batches(self, records, mb: int, feed):
-        """Device minibatches for a task from ONE decode + ONE transfer (see
-        _dispatch_training_task).  A ragged tail still goes through the
-        wrap-padded host path — at most one per task."""
-        n_full = len(records) // mb
-        big = self.trainer.shard_batch(self.spec.feed(records[: n_full * mb]))
-        for i in range(n_full):
-            yield jax.tree.map(lambda v: v[i * mb : (i + 1) * mb], big)
-        if len(records) % mb:
-            for chunk, true_count in _minibatches(records[n_full * mb :], mb, True):
-                yield self.trainer.shard_batch(feed(chunk, true_count))
-
     def _finalize_training_metrics(self, metrics_list) -> Dict[str, float]:
         """ONE device_get of the whole task's per-batch metrics, then host
         aggregation — per-batch device adds or per-scalar fetches would cost
-        a dispatch/RTT each."""
+        a dispatch/RTT each.  Entries are per-step scalar dicts OR
+        [T]-stacked dicts (the fused lax.scan path); both weigh each step
+        equally."""
         host = jax.device_get(metrics_list)
         sums: Dict[str, Any] = {}
+        n = 0
         for metrics in host:
+            steps = 1
             for k, v in metrics.items():
-                sums[k] = sums.get(k, 0.0) + np.asarray(v, np.float64)
-        n = max(len(host), 1)
+                a = np.asarray(v, np.float64)
+                if a.ndim >= 1:  # [T]-stacked scan metrics
+                    steps = a.shape[0]
+                    a = a.sum(axis=0)
+                sums[k] = sums.get(k, 0.0) + a
+            n += steps
         # finalize: scalars -> float, histogram pairs -> their scalar (AUC).
-        return finalize_metrics({k: s / n for k, s in sums.items()})
+        return finalize_metrics({k: s / max(n, 1) for k, s in sums.items()})
 
     def _run_training_task(self, task: Task) -> Dict[str, float]:
         """Synchronous task execution (profiled tasks, group/lockstep mode)."""
