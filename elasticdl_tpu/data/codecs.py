@@ -136,7 +136,19 @@ def census_feed(records: Sequence[bytes]) -> dict:
     """Census CSV -> batch, via the preprocessing layers (the reference feeds
     census through elasticdl_preprocessing hashing/number layers the same
     way; SURVEY.md §2 #15).  String categoricals are hashed host-side into a
-    31-bit id space; the model re-buckets them on device."""
+    31-bit id space; the model re-buckets them on device.  Hot path: the C++
+    decoder (same ToNumber/Hashing semantics, pinned by tests); the layer
+    pipeline below is the source of truth and fallback."""
+    try:
+        from elasticdl_tpu.ps.host_store import census_decode_native
+
+        packed = as_packed(records)
+        labels, dense, cat = census_decode_native(
+            packed.buf, packed.offsets, 1 << 31
+        )
+        return {"dense": dense, "cat": cat, "labels": labels}
+    except (RuntimeError, ImportError):
+        pass
     from elasticdl_tpu.preprocessing import Hashing, ToNumber
 
     to_number = ToNumber(out_dtype="float32", default=0.0)

@@ -99,6 +99,10 @@ def _load() -> ctypes.CDLL:
         lib.edl_criteo_decode_pre.argtypes = [
             _u8p, _i64p, _i64, _u8p, _u16p, _u16p, _i64,
         ]
+        lib.edl_census_decode.restype = _i64
+        lib.edl_census_decode.argtypes = [
+            _u8p, _i64p, _i64, _i32p, _f32p, _i32p, _i64,
+        ]
         _lib = lib
         return lib
 
@@ -277,6 +281,31 @@ def criteo_decode_native(buf: np.ndarray, offsets: np.ndarray) -> tuple:
         i = -rc - 1
         bad = bytes(buf[offsets[i] : offsets[i + 1]])
         raise ValueError(f"malformed criteo record {i}: {bad[:120]!r}")
+    return labels, dense, cat
+
+
+def census_decode_native(
+    buf: np.ndarray, offsets: np.ndarray, hash_bins: int
+) -> tuple:
+    """Census CSV decode -> (labels[n], dense[n,5] f32, cat[n,9] i32).
+
+    Numerics follow preprocessing.ToNumber (strip; empty/invalid -> 0.0);
+    strings follow preprocessing.Hashing (crc32 % hash_bins) — equality with
+    the Python feed pinned by tests/test_data.py."""
+    lib = _load()
+    buf = np.ascontiguousarray(buf, np.uint8)
+    offsets = np.ascontiguousarray(offsets, np.int64)
+    n = len(offsets) - 1
+    labels = np.zeros((n,), np.int32)
+    dense = np.zeros((n, 5), np.float32)
+    cat = np.zeros((n, 9), np.int32)
+    rc = int(
+        lib.edl_census_decode(buf, offsets, n, labels, dense, cat, hash_bins)
+    )
+    if rc < 0:
+        i = -rc - 1
+        bad = bytes(buf[offsets[i] : offsets[i + 1]])
+        raise ValueError(f"malformed census record {i}: {bad[:120]!r}")
     return labels, dense, cat
 
 

@@ -539,6 +539,59 @@ int64_t edl_criteo_decode(const uint8_t* buf, const int64_t* offsets,
   return criteo_parse<false>(buf, offsets, n, labels, dense, cat, 0u);
 }
 
+// Census CSV decode (Wide&Deep, BASELINE config #3): ``label,5 numerics,
+// 9 categorical strings`` per record.  Numerics follow the ToNumber layer
+// (strip; empty/invalid -> 0.0); strings follow the Hashing layer
+// (crc32(stripped bytes) % hash_bins — preprocessing/layers.py is the
+// source of truth, equality pinned by tests).  Returns 0 or -(i+1) on a
+// record whose label fails to parse (the only hard-error field).
+int64_t edl_census_decode(const uint8_t* buf, const int64_t* offsets,
+                          int64_t n, int32_t* labels, float* dense,
+                          int32_t* cat, int64_t hash_bins) {
+  for (int64_t i = 0; i < n; i++) {
+    const uint8_t* p = buf + offsets[i];
+    const uint8_t* rec_end = buf + offsets[i + 1];
+    int64_t lab = 0;
+    bool neg = false, any = false;
+    if (p < rec_end && *p == '-') { neg = true; p++; }
+    while (p < rec_end && *p >= '0' && *p <= '9') { lab = lab * 10 + (*p++ - '0'); any = true; }
+    if (!any || (p < rec_end && *p != ',')) return -(i + 1);
+    labels[i] = (int32_t)(neg ? -lab : lab);
+    float* drow = dense + i * 5;
+    for (int j = 0; j < 5 && p < rec_end; j++) {
+      p++;  // consume ','
+      const uint8_t* fend = p;
+      while (fend < rec_end && *fend != ',') fend++;
+      const uint8_t* s = p;
+      const uint8_t* e = fend;
+      while (s < e && (*s == ' ' || *s == '\t' || *s == '\r' || *s == '\n')) s++;
+      while (e > s && (e[-1] == ' ' || e[-1] == '\t' || e[-1] == '\r' || e[-1] == '\n')) e--;
+      if (e > s) {
+        bool ok;
+        float v;
+        criteo_float(s, e, &v, &ok);
+        if (ok) drow[j] = v;  // invalid -> stays 0.0 (ToNumber default)
+      }
+      p = fend;
+    }
+    int32_t* crow = cat + i * 9;
+    for (int j = 0; j < 9 && p < rec_end; j++) {
+      p++;
+      const uint8_t* fend = p;
+      while (fend < rec_end && *fend != ',') fend++;
+      const uint8_t* s = p;
+      const uint8_t* e = fend;
+      while (s < e && (*s == ' ' || *s == '\t' || *s == '\r' || *s == '\n')) s++;
+      while (e > s && (e[-1] == ' ' || e[-1] == '\t' || e[-1] == '\r' || e[-1] == '\n')) e--;
+      crow[j] = (int32_t)(crc32_buf(s, (size_t)(e - s)) %
+                          (uint64_t)hash_bins);
+      p = fend;
+    }
+    if (p != rec_end) return -(i + 1);
+  }
+  return 0;
+}
+
 // Preprocessed decode: labels uint8, dense float16 (log1p-normalized), cat
 // uint16 (hashed into [0, buckets); requires buckets <= 65536).  Halves the
 // host->device bytes per example — see criteo_parse.

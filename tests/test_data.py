@@ -291,6 +291,46 @@ def test_deepfm_pipeline_preprocess_matches_device_path(devices):
     np.testing.assert_allclose(outs[True], outs[False], rtol=2e-3, atol=2e-3)
 
 
+def test_census_native_decode_matches_layers():
+    """The C++ census decoder must equal the preprocessing-layer pipeline
+    (ToNumber + Hashing crc32) bit-for-bit, including blanks, whitespace,
+    decimals, and invalid numerics."""
+    from elasticdl_tpu.preprocessing import Hashing, ToNumber
+    from elasticdl_tpu.ps.host_store import native_lib_available
+
+    if not native_lib_available():
+        pytest.skip("native lib unavailable")
+    records = [
+        codecs.encode_census_example(0, [39, 13, 0, 0, 40], ["private"] * 9),
+        codecs.encode_census_example(1, [17.5, 1, 5000, 0, 12.25], ["a b", ""] + ["x"] * 7),
+        b"1, 39 ,13,,40,junk, gov,hs,married,tech,husband,white,male,us,a".replace(b"junk", b"oops"),
+        b"0,1e2,2.5,-3,0.0,4,w1,w2,w3,w4,w5,w6,w7,w8,w9",
+    ]
+
+    def layer_feed(recs):
+        to_number = ToNumber(out_dtype="float32", default=0.0)
+        hashing = Hashing(1 << 31)
+        n = len(recs)
+        dense_raw = np.empty((n, 5), object)
+        cat_raw = np.empty((n, 9), object)
+        labels = np.zeros((n,), np.int32)
+        for i, rec in enumerate(recs):
+            parts = rec.decode().split(",")
+            labels[i] = int(parts[0])
+            dense_raw[i] = parts[1:6]
+            cat_raw[i] = [v.strip() for v in parts[6:]]
+        return {
+            "dense": to_number(dense_raw),
+            "cat": hashing(cat_raw).astype(np.int32),
+            "labels": labels,
+        }
+
+    ref = layer_feed(records)
+    out = codecs.census_feed(records)
+    for key in ref:
+        np.testing.assert_array_equal(ref[key], out[key], err_msg=key)
+
+
 def test_census_codec_roundtrip():
     rec = codecs.encode_census_example(0, [39, 13, 0, 0, 40], ["private"] * 9)
     batch = codecs.census_feed([rec])
@@ -336,3 +376,13 @@ def test_synthetic_to_train_step(tmp_path, devices, family, model_def, n):
     state = trainer.init_state(jax.random.key(0))
     state, metrics = trainer.train_step(state, trainer.shard_batch(batch))
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_csv_packed_matches_iter(tmp_path):
+    path = str(tmp_path / "d.csv")
+    open(path, "wb").write(b"h1,h2\n1,a\r\n2,b\n3,c\n4,d")  # mixed EOLs, no final NL
+    reader = CSVDataReader(path, skip_header=True)
+    for shard in (Shard(path, 0, 4), Shard(path, 1, 3), Shard(path, 2, 99)):
+        assert list(reader.read_records_packed(shard)) == list(
+            reader.read_records(shard)
+        )
