@@ -120,6 +120,13 @@ class JobConfig:
     # spans every worker's chips.  Leave False for single-host jobs.
     multihost: bool = False
     coordinator_port: int = 8476
+    # jax.distributed coordination-service peer-death detection bound.
+    # Governs how long a survivor blocked in a collective on a dead peer
+    # waits before aborting into the RESTART/re-join path (JAX's own
+    # default is 100 s — measured 83 s of a 99 s re-rendezvous).  30 s
+    # tolerates heartbeat starvation on oversubscribed hosts; dedicated TPU
+    # hosts can drop to 10 s (25.7 s total re-rendezvous, docs/perf.md).
+    distributed_heartbeat_timeout_s: float = 30.0
     # Hierarchical mesh (parallel/mesh.py): > 1 builds a 2-D (dp, ep) mesh
     # whose outer dp axis strides across hosts/slices — gradient psums ride
     # DCN, but embedding tables shard over the inner ep axis so the

@@ -50,10 +50,14 @@ class DistributedSpec:
     # Coordination-service peer-death detection.  JAX's default (100 s)
     # dominates elastic recovery: a survivor blocked inside a collective on
     # a dead peer sits there until THIS timeout aborts it (measured 83 s of
-    # a 99 s total re-rendezvous — tools/rendezvous_bench.py).  10 s trades
-    # a little heartbeat traffic for ~9x faster failure detection; raise it
-    # on networks where 10 s of silence is normal.
-    heartbeat_timeout_s: int = 10
+    # a 99 s total re-rendezvous — tools/rendezvous_bench.py).  30 s is a
+    # 3.3x faster default that still tolerates heartbeat-thread starvation
+    # on oversubscribed hosts (a 10 s bound produced FALSE peer-death under
+    # 1-core CPU contention during XLA compiles: the coordinator declared a
+    # live, compiling peer dead).  Dedicated TPU hosts can set
+    # --distributed_heartbeat_timeout_s=10 for the measured 25.7 s total
+    # re-rendezvous (docs/perf.md).
+    heartbeat_timeout_s: float = 30.0
 
     @property
     def enabled(self) -> bool:
@@ -96,7 +100,7 @@ def initialize(spec: DistributedSpec) -> None:
         coordinator_address=spec.coordinator_address,
         num_processes=spec.num_processes,
         process_id=spec.process_id,
-        heartbeat_timeout_seconds=spec.heartbeat_timeout_s,
+        heartbeat_timeout_seconds=max(int(spec.heartbeat_timeout_s), 1),
     )
     _ACTIVE = spec
 
@@ -125,7 +129,10 @@ def active_spec() -> Optional[DistributedSpec]:
 
 
 def spec_from_membership(
-    membership: dict, worker_id: str, coordinator_port: int = 8476
+    membership: dict,
+    worker_id: str,
+    coordinator_port: int = 8476,
+    heartbeat_timeout_s: float = 30.0,
 ) -> DistributedSpec:
     """Derive this worker's DistributedSpec from master membership.
 
@@ -146,4 +153,5 @@ def spec_from_membership(
         coordinator_address=f"{host0}:{coordinator_port}",
         num_processes=len(ranks),
         process_id=ranks.get(worker_id, 0),
+        heartbeat_timeout_s=heartbeat_timeout_s,
     )

@@ -452,6 +452,13 @@ class Trainer:
         shardings = jax.tree.map(
             lambda x: NamedSharding(self.mesh, self._batch_spec_for(x)), batch
         )
+        return self._place_global(batch, shardings)
+
+    def _place_global(self, batch: Any, shardings: Any) -> Any:
+        """Place GLOBAL host data under per-leaf shardings: device_put on
+        single-process meshes; on multi-process meshes every process holds
+        the same global data and contributes its own slice via
+        ``jax.make_array_from_process_local_data``."""
         procs = {d_.process_index for d_ in self.mesh.devices.flat}
         if len(procs) <= 1:
             return jax.device_put(batch, shardings)
@@ -821,14 +828,7 @@ class Trainer:
             stacked,
             one,
         )
-        procs = {d.process_index for d in self.mesh.devices.flat}
-        if len(procs) <= 1:
-            return jax.device_put(stacked, shardings)
-        return jax.tree.map(
-            lambda x, s: jax.make_array_from_process_local_data(s, x),
-            stacked,
-            shardings,
-        )
+        return self._place_global(stacked, shardings)
 
     def train_scan(self, state: TrainState, stacked: Any):
         """All T steps of a task in one jitted lax.scan (one dispatch, one

@@ -75,6 +75,17 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     set_level(config.log_level)
     worker_id = os.environ.get("ELASTICDL_WORKER_ID", f"worker-{os.getpid()}")
+    logger.info("worker %s booting (pid %d)", worker_id, os.getpid())
+    # Persistent XLA compile cache: every elastic re-join re-jits the train
+    # step for its (program, topology); relaunched incarnations load the
+    # executable from disk instead of recompiling (~20-40 s on TPU).  This
+    # also bounds COMPILE SKEW between gang members forming a collective:
+    # XLA:CPU's Gloo context init times out (hard 30 s) if one process is
+    # still compiling while its peer already executes — observed when the
+    # fused-scan compile ran under CPU contention.
+    from elasticdl_tpu.common.platform import enable_compile_cache
+
+    enable_compile_cache()
 
     master = RpcMasterProxy(config.master_addr)
     # Register EXACTLY ONCE, before any jax computation.  The membership view
@@ -108,6 +119,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                 pass
 
     threading.Thread(target=_beat, daemon=True, name="heartbeat").start()
+    logger.info(
+        "worker %s registered (membership v%s, world %s)",
+        worker_id, membership.get("version"), membership.get("world_size"),
+    )
 
     if config.multihost:
         deadline = time.time() + SETTLE_MAX_S
@@ -118,7 +133,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                 break
             membership = current
         spec = distributed.spec_from_membership(
-            membership, worker_id, config.coordinator_port
+            membership,
+            worker_id,
+            config.coordinator_port,
+            heartbeat_timeout_s=config.distributed_heartbeat_timeout_s,
         )
         distributed.initialize(spec)
     worker = Worker(

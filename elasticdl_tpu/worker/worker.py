@@ -128,6 +128,7 @@ class Worker:
         self._membership_version = -1
         self._rank = 0
         self._ranks: Dict[str, int] = {}
+        self._addresses: Dict[str, str] = {}
         # Multi-host lockstep: all processes of the world walk the master's
         # group task log in the same order (GetGroupTask seq counter); only
         # rank 0 reports results.
@@ -167,9 +168,26 @@ class Worker:
         version = membership["version"]
         if version == self._membership_version:
             return
+        if not initial and dict(membership["ranks"]) == self._ranks and (
+            dict(membership.get("addresses") or {}) == self._addresses
+        ):
+            # Version churn with IDENTICAL topology: a peer's restart cycle
+            # bumps the version twice (stale-incarnation eviction, then
+            # re-registration) and can net out to exactly the membership
+            # this worker already runs.  Restarting on the NUMBER alone made
+            # two workers ping-pong restarts forever (each restart causing
+            # the next bump); the world is defined by ranks+addresses, so
+            # adopt the version and keep the world.
+            logger.info(
+                "membership v%d has identical topology; adopting without "
+                "re-forming", version,
+            )
+            self._membership_version = version
+            return
         world = max(membership["world_size"], 1)
         prev_ranks = self._ranks
         self._ranks = dict(membership["ranks"])
+        self._addresses = dict(membership.get("addresses") or {})
         self._rank = self._ranks.get(self.worker_id, 0)
         self._group_mode = self.config.multihost and len(self._ranks) > 1
         if self.config.multihost and not initial:
@@ -522,6 +540,42 @@ class Worker:
         metrics_list, _ = self._dispatch_training_task(task)
         return self._finalize_training_metrics(metrics_list)
 
+    #: Collective-formation failures worth retrying in place: a gang member
+    #: still COMPILING while its peer already executes trips the runtime's
+    #: hard context-init deadline (XLA:CPU Gloo: 30 s).  The peer just needs
+    #: time, not a group teardown — by the retry it has usually reached its
+    #: side of the collective.  Anything else stays fatal (desync -> the
+    #: deregister/restart path).
+    _TRANSIENT_COLLECTIVE_MARKERS = (
+        "Gloo context initialization failed",
+        "context initialization failed",
+    )
+    _GROUP_TASK_ATTEMPTS = 3
+
+    def _run_group_training_task(self, task: Task) -> Dict[str, float]:
+        for attempt in range(self._GROUP_TASK_ATTEMPTS):
+            try:
+                return self._run_training_task(task)
+            except Exception as e:  # noqa: BLE001 — filtered below
+                msg = str(e)
+                transient = any(
+                    m in msg for m in self._TRANSIENT_COLLECTIVE_MARKERS
+                )
+                if not transient or attempt == self._GROUP_TASK_ATTEMPTS - 1:
+                    raise
+                # _dispatch_training_task already settled self.state
+                # (adopted the last live state or recovered from the
+                # checkpoint), so an immediate re-dispatch is safe and
+                # keeps the collective ORDER identical across the gang.
+                logger.warning(
+                    "transient collective-formation failure on task %d "
+                    "(attempt %d/%d): %s — retrying",
+                    task.task_id, attempt + 1, self._GROUP_TASK_ATTEMPTS,
+                    msg[:200],
+                )
+                time.sleep(1.0)
+        raise AssertionError("unreachable")  # pragma: no cover
+
     def _flush(self, pending: Optional[tuple]) -> None:
         """Settle a pipelined task: fetch its device metrics, report, and
         run the checkpoint hook.  A fetch failure fails THAT task's report
@@ -748,7 +802,11 @@ class Worker:
                             )
                             self._flush(prev)
                             continue
-                        metrics = self._run_training_task(task)
+                        metrics = (
+                            self._run_group_training_task(task)
+                            if self._group_mode
+                            else self._run_training_task(task)
+                        )
                     finally:
                         if profiling:
                             jax.block_until_ready(self.state)

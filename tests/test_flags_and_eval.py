@@ -183,16 +183,21 @@ def test_training_metrics_averaged(tmp_path, devices):
     worker.state = worker.trainer.init_state(jax.random.key(0))
 
     seen = []
-    orig = worker.trainer.train_step
+    orig_scan = worker.trainer.train_scan
 
-    def spy(state, batch):
-        state, metrics = orig(state, batch)
-        seen.append({k: float(v) for k, v in metrics.items()})
+    def spy_scan(state, stacked):
+        state, metrics = orig_scan(state, stacked)
+        arr = {k: np.asarray(v) for k, v in metrics.items()}
+        n = next(iter(arr.values())).shape[0]
+        for t in range(n):
+            seen.append({k: float(v[t]) for k, v in arr.items()})
         return state, metrics
 
-    worker.trainer.train_step = spy
+    worker.trainer.train_scan = spy_scan
     task = Task(task_id=0, shard=Shard(name=path, start=0, end=32))
     got = worker._run_training_task(task)
+    # The fused path runs the task's 2 minibatches in one lax.scan; the
+    # reported metrics must still be the mean over BOTH steps.
     assert len(seen) == 2
     for k in got:
         np.testing.assert_allclose(

@@ -254,6 +254,13 @@ def _spawn_worker(worker_id: str, config: JobConfig, log_dir) -> subprocess.Pope
     env["ELASTICDL_WORKER_ID"] = worker_id
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    # Per-test compile cache, shared by the gang: incarnations re-join
+    # without recompiling, and — critically — the cache state stays
+    # SYMMETRIC across gang members.  A global cache left one member with a
+    # warm hit and the other compiling cold, and that skew (under 1-core
+    # contention) outlived XLA:CPU's hard 30 s Gloo context-init window,
+    # collapsing every world formation.
+    env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(str(log_dir), "jax_cache")
     env.pop("PALLAS_AXON_POOL_IPS", None)  # never grab the real TPU tunnel
     # One log file PER INCARNATION: tail checks (fatal-marker classification)
     # must see only the CURRENT incarnation — a stale marker from a previous
@@ -301,7 +308,10 @@ def test_real_process_scale_4_8_4(tmp_path):
     # Long task stream: the joiner needs ~15s to boot (jax import +
     # distributed init), and the solo phase must not drain the job first.
     dispatcher = TaskDispatcher(shards, num_epochs=60)
-    rendezvous = RendezvousServer(heartbeat_timeout_s=6.0)
+    # 20 s reaper: a joiner compiling under 1-core contention (the incumbent
+    # saturates the core since the r4 fused-scan loop) can starve its
+    # liveness thread past 6 s; evicting it mid-join collapses the world.
+    rendezvous = RendezvousServer(heartbeat_timeout_s=20.0)
     servicer = MasterServicer(dispatcher, rendezvous=rendezvous)
     from elasticdl_tpu.master.servicer import MasterServer
 
@@ -326,6 +336,22 @@ def test_real_process_scale_4_8_4(tmp_path):
         checkpoint_dir=str(tmp_path / "ckpt"),
         checkpoint_steps=4,
         num_epochs=60,
+        # This harness runs 3 python processes on ONE core: a freshly joined
+        # peer's coordination heartbeats can starve >30 s during restore +
+        # first compile, and the r4 default (30 s) then produces FALSE
+        # peer-death that churns the world until the phase deadline.  Use
+        # the conservative bound this scenario needs (JAX's own default,
+        # what r3 implicitly ran with); kill-driven tests keep the fast
+        # default so aborts stay quick.
+        distributed_heartbeat_timeout_s=100.0,
+        # The r4 fused-scan loop saturates the core; a solo incumbent then
+        # starves the JOINER's cold compile past XLA:CPU's hard 30 s Gloo
+        # context-init window, collapsing every world formation on this
+        # 1-core harness.  The per-batch path (prefetch_depth=0) leaves the
+        # scheduler slack the join needs; the fused path's multi-process
+        # correctness is covered by test_two_process_distributed_train_
+        # kill_resume, where the gang compiles symmetrically.
+        prefetch_depth=0,
     )
     procs: dict = {}
 
