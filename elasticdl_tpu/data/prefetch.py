@@ -32,33 +32,49 @@ def prefetch(iterable: Iterable, depth: int = 2) -> Iterator:
     consumer's next pull (fail-loud: a malformed record must kill the task,
     not vanish into a thread).  ``depth < 1`` returns the iterable unchanged.
 
-    If the consumer abandons iteration early (task failure mid-shard), the
-    producer thread parks on the bounded queue until the generator is
-    garbage-collected — it holds no locks and is a daemon, so this leaks at
-    most ``depth`` batches briefly, never a hang.
+    A consumer that abandons iteration early (task failure mid-shard)
+    cancels the producer: the generator's close/GC sets the cancel event,
+    and the producer — which only ever blocks on the queue with a short
+    timeout — notices and exits, dropping its buffered batches.  Without
+    that, every abandoned task would pin a thread plus ``depth`` decoded
+    batches forever.
     """
     if depth < 1:
         return iter(iterable)
     q: queue.Queue = queue.Queue(maxsize=depth)
+    cancelled = threading.Event()
+
+    def _put(item) -> bool:
+        while not cancelled.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def _produce() -> None:
         try:
             for item in iterable:
-                q.put(item)
+                if not _put(item):
+                    return
         except BaseException as e:  # noqa: BLE001 — transported to consumer
-            q.put(_Failure(e))
+            _put(_Failure(e))
             return
-        q.put(_DONE)
+        _put(_DONE)
 
     threading.Thread(target=_produce, name="edl-prefetch", daemon=True).start()
 
     def _consume() -> Iterator:
-        while True:
-            item = q.get()
-            if item is _DONE:
-                return
-            if isinstance(item, _Failure):
-                raise item.exc
-            yield item
+        try:
+            while True:
+                item = q.get()
+                if item is _DONE:
+                    return
+                if isinstance(item, _Failure):
+                    raise item.exc
+                yield item
+        finally:
+            cancelled.set()
 
     return _consume()

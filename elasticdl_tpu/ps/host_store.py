@@ -66,6 +66,16 @@ def _load() -> ctypes.CDLL:
                 lib = ctypes.CDLL(_LIB_PATH)
         except (subprocess.CalledProcessError, OSError) as e:
             _lib_error = f"native lib unavailable: {e}"
+            # Said ONCE, loudly: every ingest hot path (criteo/census
+            # decode, bulk recordio reads, host stores) silently degrades to
+            # Python fallbacks that are ~80x slower (docs/perf.md) — a
+            # profile-invisible collapse unless it is logged.  Subsequent
+            # calls fail fast on the cached error without re-logging.
+            logger.warning(
+                "%s — ingest/PS hot paths fall back to Python "
+                "implementations (~80x slower decode; see docs/perf.md)",
+                _lib_error,
+            )
             raise RuntimeError(_lib_error) from e
 
         lib.edl_store_create.restype = ctypes.c_void_p

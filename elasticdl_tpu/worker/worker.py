@@ -178,6 +178,18 @@ class Worker:
             # two workers ping-pong restarts forever (each restart causing
             # the next bump); the world is defined by ranks+addresses, so
             # adopt the version and keep the world.
+            #
+            # Accepted hazard: ranks+addresses cannot distinguish a
+            # RELAUNCHED peer on the same host from the incarnation this
+            # worker's jax world actually spans, so adoption can briefly
+            # keep a world whose peer process is new.  That wedge is
+            # BOUNDED: the next collective aborts on the coordination
+            # heartbeat (--distributed_heartbeat_timeout_s) and the restart
+            # path re-forms.  Comparing per-worker incarnation nonces
+            # instead would close the wedge but re-open the ping-pong (a
+            # restart always bumps its own nonce, forcing the peer to
+            # restart, which bumps again...), which does NOT self-heal —
+            # the bounded wedge is the better failure mode.
             logger.info(
                 "membership v%d has identical topology; adopting without "
                 "re-forming", version,
@@ -473,6 +485,10 @@ class Worker:
                 self.state = e.state
             else:
                 self._recover_state()
+            # Resync the python-side step mirror: recovery may have landed
+            # on an older step, and later pipelined reports derive
+            # model_version from this counter.
+            self._steps_dispatched = int(self.state.step)
             raise
         except Exception:
             from elasticdl_tpu.parallel.trainer import _state_alive
@@ -480,6 +496,7 @@ class Worker:
             # Same donated-state hazard for the fused path's direct calls.
             if not _state_alive(self.state):
                 self._recover_state()
+            self._steps_dispatched = int(self.state.step)
             raise
         # Start the D2H copy of the task's metrics NOW, in the background:
         # the runtime moves each value to the host as soon as its step

@@ -386,3 +386,29 @@ def test_csv_packed_matches_iter(tmp_path):
         assert list(reader.read_records_packed(shard)) == list(
             reader.read_records(shard)
         )
+
+
+def test_prefetch_cancellation_releases_producer():
+    """An abandoned consumer must cancel the producer thread (pre-r4-review
+    it parked on the bounded queue forever, pinning decoded batches)."""
+    import threading
+    import time
+
+    from elasticdl_tpu.data.prefetch import prefetch
+
+    produced = []
+
+    def gen():
+        for i in range(1000):
+            produced.append(i)
+            yield i
+
+    before = threading.active_count()
+    it = prefetch(gen(), depth=2)
+    assert next(it) == 0
+    it.close()  # abandon mid-iteration -> cancel event fires
+    deadline = time.time() + 5.0
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before, "producer thread leaked"
+    assert len(produced) < 1000  # producer stopped early, not drained

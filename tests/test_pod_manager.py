@@ -262,8 +262,7 @@ def _fake_pod(name, phase, exit_code=None, broken=False):
     from types import SimpleNamespace as NS
 
     if broken:
-        status = NS(phase=phase, container_statuses=[NS(state=None)])
-        # make attribute access explode like a half-populated API object
+        # attribute access explodes like a half-populated API object
         class Boom:
             @property
             def container_statuses(self):
