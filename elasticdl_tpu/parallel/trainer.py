@@ -861,6 +861,29 @@ class Trainer:
         )
         return self._eval_step(state, batch)
 
+    def eval_scan(self, state: TrainState, stacked: Any):
+        """All T eval steps of a task in one jitted lax.scan (see
+        build_eval_step(scan_steps=True)).  Returns a metrics dict of
+        [T]-stacked leaves; the caller weights per-chunk as usual."""
+        key = ("scan", jax.tree.structure(stacked))
+        fn = self._eval_steps.get(key)
+        if fn is None:
+            one = jax.eval_shape(
+                lambda t: jax.tree.map(lambda v: v[0], t), stacked
+            )
+            fn = build_eval_step(
+                self.spec,
+                self.mesh,
+                self.ctx,
+                self.state_specs(),
+                batch_specs=self.batch_specs(one),
+                batch_axes=self.batch_axes,
+                scan_steps=True,
+            )
+            self._eval_steps[key] = fn
+        self._eval_step = fn
+        return fn(state, stacked)
+
     def predict_step(self, state: TrainState, batch: Any):
         self._predict_step = self._structured(
             self._predict_steps, build_predict_step, batch
@@ -1047,6 +1070,7 @@ def build_eval_step(
     state_specs: TrainState,
     batch_specs: Any = None,
     batch_axes: Optional[Tuple[str, ...]] = None,
+    scan_steps: bool = False,
 ) -> Callable:
     axis = ctx.axis_name
     assert axis is not None
@@ -1072,6 +1096,34 @@ def build_eval_step(
                 k: lax.psum(v * count, axes) / total for k, v in metrics.items()
             }
         return {k: lax.pmean(v, axes) for k, v in spec.metrics(out, batch).items()}
+
+    if scan_steps:
+        # Stacked [T, ...] batches, all T eval steps in one lax.scan — the
+        # eval-side twin of the fused training task (one dispatch per eval
+        # task).  Masked tails stay outside the scan (the worker evals them
+        # as one extra step), so the scanned chunks are all full-size and
+        # the per-chunk metric weighting stays host-side as before.
+        def local_eval_scan(state: TrainState, batches):
+            def body(carry, batch):
+                return carry, local_eval(state, batch)
+
+            _, metrics = lax.scan(body, 0, batches)
+            return metrics
+
+        one_step_specs = batch_specs if batch_specs is not None else P(axis)
+        stacked_specs = jax.tree.map(
+            lambda s: P(None, *s),
+            one_step_specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        mapped = shard_map(
+            local_eval_scan,
+            mesh=mesh,
+            in_specs=(state_specs, stacked_specs),
+            out_specs=P(),
+            check_vma=False,
+        )
+        return jax.jit(mapped)
 
     mapped = shard_map(
         local_eval,

@@ -620,31 +620,61 @@ class Worker:
 
     def _run_evaluation_task(self, task: Task) -> tuple:
         records = self._read_records(task.shard)
+        mb = self.config.minibatch_size
         sums: Dict[str, Any] = {}
         total = 0.0
 
+        def _accumulate(metrics, true_count):
+            nonlocal total
+            for k, v in metrics.items():
+                # Histogram metrics (streaming AUC) are vectors; accumulate
+                # with the same count weighting as the scalars.
+                sums[k] = sums.get(k, 0.0) + np.asarray(v, np.float64) * true_count
+            total += true_count
+
+        n_full = len(records) // mb
+        if (
+            not self.spec.host_io
+            and self.config.prefetch_depth > 0
+            and n_full >= 1
+        ):
+            # Fused eval: all full chunks in ONE decode + transfer + scan
+            # (the eval twin of the fused training task); only the masked
+            # tail runs as a separate step.
+            big = self.spec.feed(records[: n_full * mb])
+            stacked = jax.tree.map(
+                lambda v: np.ascontiguousarray(v).reshape(
+                    (n_full, mb) + v.shape[1:]
+                ),
+                dict(big),
+            )
+            metrics = jax.device_get(
+                self.trainer.eval_scan(
+                    self.state, self.trainer.shard_stacked_batch(stacked)
+                )
+            )
+            for t in range(n_full):
+                _accumulate({k: v[t] for k, v in metrics.items()}, mb)
+            tail = records[n_full * mb :]
+        else:
+            tail = records
+
         def _batches():
-            for chunk, true_count in _minibatches(
-                records, self.config.minibatch_size, False
-            ):
+            for chunk, true_count in _minibatches(tail, mb, False):
                 batch = dict(self.spec.feed(chunk))
                 # Real-vs-padding mask for the wrap-padded tail: metrics
                 # count only real rows (see models/metrics.py) — without it
                 # the duplicated examples were over-weighted.
-                batch["__mask__"] = (
-                    np.arange(self.config.minibatch_size) < true_count
-                ).astype(np.float32)
+                batch["__mask__"] = (np.arange(mb) < true_count).astype(
+                    np.float32
+                )
                 yield batch, true_count
 
         for batch, true_count in prefetch(
             _batches(), self.config.prefetch_depth
         ):
             metrics = self.trainer.run_eval_step(self.state, batch)
-            for k, v in metrics.items():
-                # Histogram metrics (streaming AUC) are vectors; accumulate
-                # with the same count weighting as the scalars.
-                sums[k] = sums.get(k, 0.0) + np.asarray(v, np.float64) * true_count
-            total += true_count
+            _accumulate(metrics, true_count)
         # Report RAW weighted means — including histogram vectors (as JSON
         # lists) — so the MASTER's cross-worker aggregation stays exact; it
         # derives the AUC scalar at round end (evaluation_service).

@@ -231,3 +231,44 @@ def test_dispatcher_stop_is_sticky(tmp_path):
     d.recover_tasks("w1")
     assert d.counts()["todo"] == 0
     assert d.finished()
+
+
+def test_eval_scan_matches_per_batch(tmp_path, devices):
+    """Fused eval (lax.scan over full chunks) must reproduce the per-batch
+    eval path's aggregated metrics exactly (incl. AUC histograms)."""
+    from elasticdl_tpu.models.spec import load_model_spec
+    from elasticdl_tpu.worker.worker import Worker
+    from elasticdl_tpu.master.task_dispatcher import TASK_EVALUATION, Task
+
+    path, reader, _ = _mk_shards(tmp_path, n=40, per_task=40)
+    spec = load_model_spec(
+        "elasticdl_tpu.models", "mnist.model_spec", compute_dtype="float32"
+    )
+
+    def run(prefetch_depth):
+        config = JobConfig(
+            model_def="mnist.model_spec", training_data=path,
+            minibatch_size=16, prefetch_depth=prefetch_depth,
+        )
+        worker = Worker(
+            config, master=None, reader=reader, spec=spec, devices=devices
+        )
+        worker._apply_membership(
+            {"version": 0, "world_size": 1, "ranks": {"w": 0}}, initial=True
+        )
+        worker.state = worker.trainer.init_state(jax.random.key(0))
+        task = Task(
+            task_id=0, shard=Shard(name=path, start=0, end=40),
+            type=TASK_EVALUATION,
+        )
+        return worker._run_evaluation_task(task)
+
+    fused_metrics, fused_total = run(prefetch_depth=2)   # scan + masked tail
+    plain_metrics, plain_total = run(prefetch_depth=0)   # per-batch path
+    assert fused_total == plain_total == 40
+    assert set(fused_metrics) == set(plain_metrics)
+    for k in fused_metrics:
+        np.testing.assert_allclose(
+            fused_metrics[k], plain_metrics[k], rtol=1e-6, atol=1e-9,
+            err_msg=k,
+        )
