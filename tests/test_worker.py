@@ -253,3 +253,43 @@ def test_failed_step_recovers_state(tmp_path, devices):
     assert result["step"] >= 6  # all shards trained (failed task re-run)
     status = servicer.JobStatus({})
     assert status["done"] == 3 and status["todo"] == 0
+
+
+def test_corrupt_recordio_fails_task_cleanly(tmp_path, devices):
+    """A shard whose payload got corrupted on disk must fail ITS task loudly
+    (CRC catch in the bulk C++ read) without wedging the worker; the healthy
+    shards complete and the corrupt one lands in the abandoned count after
+    its retry budget."""
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+
+    data = str(tmp_path / "t.rio")
+    generate("mnist", data, 48)
+    # Corrupt one byte inside the SECOND shard's records (records 16-31).
+    from elasticdl_tpu.data.recordio import RecordIOReader
+
+    offsets = RecordIOReader(data).index()
+    with open(data, "r+b") as f:
+        f.seek(offsets[20] + 12)  # inside record 20's payload
+        b = f.read(1)
+        f.seek(offsets[20] + 12)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+    config = JobConfig(model_def="mnist.model_spec", minibatch_size=16)
+    reader = create_data_reader(data)
+    dispatcher = TaskDispatcher(
+        reader.create_shards(16), max_task_retries=2
+    )
+    servicer = MasterServicer(dispatcher)
+    spec = load_model_spec(
+        "elasticdl_tpu.models", "mnist.model_spec", **MNIST_TINY
+    )
+    worker = Worker(
+        config, DirectMasterProxy(servicer), reader,
+        worker_id="w0", spec=spec, devices=devices,
+    )
+    result = worker.run()
+    status = servicer.JobStatus({})
+    assert status["finished"]
+    assert status["done"] == 2          # healthy shards trained
+    assert status["abandoned"] == 1     # corrupt shard burned its retries
+    assert result["step"] == 2          # 2 healthy tasks x 1 step each
