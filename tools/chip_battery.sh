@@ -1,0 +1,21 @@
+#!/bin/bash
+# One-shot on-chip measurement battery (round 4).  Run from the repo root
+# with the real TPU reachable; each stage appends its JSON to the log.
+# Stages are ordered headline-first so a mid-battery chip flake still
+# leaves the most important artifacts.  NEVER run two stages concurrently.
+set -u
+LOG=${1:-/tmp/chip_battery.log}
+echo "== chip battery $(date -u +%H:%M:%S)" | tee -a "$LOG"
+
+run() {
+  echo "-- $1" | tee -a "$LOG"
+  shift
+  timeout 600 "$@" 2>>"$LOG" | tee -a "$LOG"
+  echo "-- rc=$?" | tee -a "$LOG"
+}
+
+run "bench.py (headline: e2e DeepFM)"      python bench.py
+run "bench_all (configs 1-3 + MFU)"        python tools/bench_all.py
+run "train_job (full stack artifact)"      python tools/train_job_tpu.py
+run "async depth sweep (host tier)"        python tools/async_depth_bench.py --steps 20
+echo "== battery done $(date -u +%H:%M:%S)" | tee -a "$LOG"
