@@ -818,42 +818,53 @@ class Trainer:
         """Place a HOST batch of stacked minibatches ([T, mb, ...] per leaf)
         on the mesh in ONE transfer, sharded per STEP (leading scan dim
         replicated, batch dims sharded as usual)."""
-        one = jax.eval_shape(
-            lambda t: jax.tree.map(lambda v: v[0], t), stacked
-        )
         shardings = jax.tree.map(
             lambda x, o: NamedSharding(
                 self.mesh, P(None, *self._batch_spec_for(o))
             ),
             stacked,
-            one,
+            self._one_step_shapes(stacked),
         )
         return self._place_global(stacked, shardings)
+
+    @staticmethod
+    def _one_step_shapes(stacked: Any):
+        """ShapeDtypeStructs of a single step of a stacked [T, ...] batch —
+        the shape basis for scan-variant specs (shared by
+        shard_stacked_batch / train_scan / eval_scan so the three cannot
+        drift)."""
+        return jax.eval_shape(
+            lambda t: jax.tree.map(lambda v: v[0], t), stacked
+        )
+
+    def _scanned(self, cache: Dict, build, stacked: Any, **kwargs):
+        """Scan-variant twin of _structured: build (or fetch) the fused
+        lax.scan step for this stacked batch's tree structure."""
+        key = ("scan", jax.tree.structure(stacked))
+        fn = cache.get(key)
+        if fn is None:
+            fn = build(
+                self.spec,
+                self.mesh,
+                self.ctx,
+                self.state_specs(),
+                batch_specs=self.batch_specs(self._one_step_shapes(stacked)),
+                batch_axes=self.batch_axes,
+                scan_steps=True,
+                **kwargs,
+            )
+            cache[key] = fn
+        return fn
 
     def train_scan(self, state: TrainState, stacked: Any):
         """All T steps of a task in one jitted lax.scan (one dispatch, one
         compiled program — see build_train_step(scan_steps=True)).
         ``stacked``: device batch from shard_stacked_batch.  Returns
         (state, metrics dict of [T]-stacked scalars)."""
-        key = ("scan", jax.tree.structure(stacked))
-        fn = self._train_steps.get(key)
-        if fn is None:
-            one = jax.eval_shape(
-                lambda t: jax.tree.map(lambda v: v[0], t), stacked
-            )
-            fn = build_train_step(
-                self.spec,
-                self.mesh,
-                self.ctx,
-                self.state_specs(),
-                host_keys=(),
-                batch_specs=self.batch_specs(one),
-                batch_axes=self.batch_axes,
-                scan_steps=True,
-            )
-            self._train_steps[key] = fn
-        self._train_step = fn
-        return fn(state, stacked)
+        self._train_step = self._scanned(
+            self._train_steps, build_train_step, stacked, host_keys=()
+        )
+        return self._train_step(state, stacked)
 
     def eval_step(self, state: TrainState, batch: Any) -> Dict[str, jax.Array]:
         self._eval_step = self._structured(
@@ -865,24 +876,10 @@ class Trainer:
         """All T eval steps of a task in one jitted lax.scan (see
         build_eval_step(scan_steps=True)).  Returns a metrics dict of
         [T]-stacked leaves; the caller weights per-chunk as usual."""
-        key = ("scan", jax.tree.structure(stacked))
-        fn = self._eval_steps.get(key)
-        if fn is None:
-            one = jax.eval_shape(
-                lambda t: jax.tree.map(lambda v: v[0], t), stacked
-            )
-            fn = build_eval_step(
-                self.spec,
-                self.mesh,
-                self.ctx,
-                self.state_specs(),
-                batch_specs=self.batch_specs(one),
-                batch_axes=self.batch_axes,
-                scan_steps=True,
-            )
-            self._eval_steps[key] = fn
-        self._eval_step = fn
-        return fn(state, stacked)
+        self._eval_step = self._scanned(
+            self._eval_steps, build_eval_step, stacked
+        )
+        return self._eval_step(state, stacked)
 
     def predict_step(self, state: TrainState, batch: Any):
         self._predict_step = self._structured(

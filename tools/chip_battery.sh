@@ -11,7 +11,8 @@ run() {
   echo "-- $1" | tee -a "$LOG"
   shift
   timeout 600 "$@" 2>>"$LOG" | tee -a "$LOG"
-  echo "-- rc=$?" | tee -a "$LOG"
+  # rc of the benchmarked command, not tee's (124 = timeout kill)
+  echo "-- rc=${PIPESTATUS[0]}" | tee -a "$LOG"
 }
 
 run "bench.py (headline: e2e DeepFM)"      python bench.py
