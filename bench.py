@@ -39,7 +39,11 @@ import sys
 import threading
 import time
 
-from elasticdl_tpu.common.platform import apply_platform_env, enable_compile_cache
+from elasticdl_tpu.common.platform import (
+    apply_platform_env,
+    enable_compile_cache,
+    probe_devices,
+)
 
 apply_platform_env()
 
@@ -53,16 +57,32 @@ import jax.numpy as jnp  # noqa: E402
 # parseable line naming the phase that hung.
 WATCHDOG_DEADLINE_S = float(os.environ.get("BENCH_WATCHDOG_S", "480"))
 
-# Stand-in for the unpublished reference number (see module docstring).
-REFERENCE_EXAMPLES_PER_SEC_PER_CHIP = 120_000.0
+# Stand-ins for the unpublished reference number (see module docstring).
+# Kept SEPARATE per metric: r1-r3 compared the *device-step* figure against
+# the ~120k/GPU estimate; r4 switched the headline to *end-to-end*, which in
+# the reference's own story is also what a V100 job sustains (the estimate
+# already includes its input pipeline), so the same stand-in applies — but a
+# consumer of the old metric name must not silently read the new one
+# (ADVICE r4 #4), hence the explicit ``renamed_from`` field in the output.
+REFERENCE_E2E_EXAMPLES_PER_SEC_PER_CHIP = 120_000.0
+REFERENCE_DEVICE_STEP_EXAMPLES_PER_SEC_PER_CHIP = 120_000.0
 
 GLOBAL_BATCH = 8192
 WARMUP_STEPS = 5
 MEASURE_STEPS = 30
 RETRIES = 4
 BACKOFF_S = 15.0
+# Killable-subprocess device probes before the first in-process backend
+# touch (worst case 4x90s + backoffs = ~390s, safely inside the watchdog).
+PROBE_ATTEMPTS = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "4"))
+PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "90"))
 
-_state = {"phase": "start", "t0": time.time(), "emitted": False}
+_state = {
+    "phase": "start",
+    "t0": time.time(),
+    "emitted": False,
+    "deadline": time.time() + WATCHDOG_DEADLINE_S,
+}
 
 
 def _log(phase: str, msg: str = "") -> None:
@@ -72,7 +92,15 @@ def _log(phase: str, msg: str = "") -> None:
 
 
 def _watchdog() -> None:
-    time.sleep(WATCHDOG_DEADLINE_S)
+    # The deadline is re-armed once the device probe succeeds (a probe can
+    # legitimately consume most of the first window when the chip is flaky
+    # at minute 0 and fine at minute 4 — the budget must then still cover
+    # init + compile + measure, or the probe's rescue was pointless).
+    while True:
+        remaining = _state["deadline"] - time.time()
+        if remaining <= 0:
+            break
+        time.sleep(min(remaining, 5.0))
     hung_phase = _state["phase"]  # capture BEFORE logging mutates it
     _log("watchdog", f"phase {hung_phase!r} still running after "
                      f"{WATCHDOG_DEADLINE_S:.0f}s; force-exiting")
@@ -92,10 +120,13 @@ def _emit(
     _state["emitted"] = True
     line = {
         "metric": "deepfm_criteo_e2e_examples_per_sec_per_chip",
+        # r4 renamed the headline from the device-step metric; trend lines
+        # across rounds 1-3 compare against device_step_* in extras instead.
+        "renamed_from": "deepfm_criteo_examples_per_sec_per_chip",
         "value": round(value, 1) if value is not None else None,
         "unit": "examples/sec/chip",
         "vs_baseline": (
-            round(value / REFERENCE_EXAMPLES_PER_SEC_PER_CHIP, 3)
+            round(value / REFERENCE_E2E_EXAMPLES_PER_SEC_PER_CHIP, 3)
             if value is not None
             else None
         ),
@@ -141,6 +172,20 @@ def main() -> None:
     threading.Thread(target=_watchdog, name="bench-watchdog", daemon=True).start()
     enable_compile_cache()
 
+    # A hang in jax.devices() (the twice-recorded chip failure, BENCH_r02/
+    # r04) is not an exception, so _retry can't save it and the watchdog
+    # only records the corpse.  Probe the backend in killable subprocesses
+    # first; enter the un-killable in-process init only once a probe has
+    # answered, and fail fast (partial artifact) when none does.
+    _log("init", "probing device backend in subprocess")
+    probe_devices(
+        attempts=PROBE_ATTEMPTS,
+        timeout_s=PROBE_TIMEOUT_S,
+        log=lambda m: _log("init", m),
+    )
+    # Re-arm: a late-succeeding probe must not have eaten the budget the
+    # remaining phases (init/compile/measure/e2e) still need.
+    _state["deadline"] = time.time() + WATCHDOG_DEADLINE_S
     _log("init", "querying devices")
     devices = _retry("init", jax.devices)
     n = len(devices)
@@ -227,6 +272,10 @@ def main() -> None:
     extras = {
         "device_step_examples_per_sec_per_chip": round(eps_per_chip, 1),
         "device_step_ms": round(step_ms, 3),
+        # Cross-round trend line vs r1-r3, which benched this metric.
+        "device_step_vs_baseline": round(
+            eps_per_chip / REFERENCE_DEVICE_STEP_EXAMPLES_PER_SEC_PER_CHIP, 3
+        ),
     }
 
     # Phase 2: end-to-end through the real worker loop (the headline).

@@ -13,6 +13,9 @@ wins over the sitecustomize default.
 from __future__ import annotations
 
 import os
+import subprocess
+import sys
+import time
 
 
 def apply_platform_env() -> None:
@@ -43,3 +46,78 @@ def enable_compile_cache(path: str | None = None) -> None:
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     # Cache even fast compiles: elastic resizes re-trace many small steps.
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+
+# The probe must honor JAX_PLATFORMS the way apply_platform_env() does —
+# the image's sitecustomize forces jax_platforms to the tunneled TPU plugin,
+# so a bare ``jax.devices()`` subprocess spawned from a CPU-only test/tool
+# would try the real (possibly hung) chip regardless of the env var.
+# Inlined (not imported) so the subprocess needs nothing on sys.path.
+_PROBE_CODE = (
+    "import os, sys; import jax; "
+    "p = os.environ.get('JAX_PLATFORMS'); "
+    "p and jax.config.update('jax_platforms', p); "
+    "d = jax.devices(); "
+    "sys.stdout.write('%d %s' % (len(d), d[0].platform))"
+)
+
+
+def probe_devices(
+    attempts: int = 4,
+    timeout_s: float = 100.0,
+    backoff_s: float = 10.0,
+    log=None,
+) -> str:
+    """Probe the JAX backend in killable subprocesses before touching it.
+
+    The twice-recorded chip failure mode (BENCH_r02/r04) is a *hang* inside
+    ``jax.devices()`` — not an exception — so retry-on-exception loops never
+    fire and the first in-process backend touch burns the whole watchdog
+    budget.  The only killable unit is a separate process: spawn
+    ``python -c 'jax.devices()'`` (inheriting the parent environment
+    unchanged, so the out-of-process TPU plugin registration survives) with
+    a hard timeout, bounded attempts, backoff between them.  A transient
+    "chip flaky at minute 0, fine at minute 2" then costs one killed probe
+    instead of a null artifact.
+
+    Returns the successful probe's ``"<n> <platform>"`` line.  Raises
+    ``RuntimeError`` once every attempt has hung or failed — callers turn
+    that into an immediate partial artifact instead of a watchdog
+    force-exit.
+    """
+    say = log or (lambda m: print(m, file=sys.stderr, flush=True))
+    if os.environ.get("EDL_SKIP_PROBE") == "1":
+        # The battery (tools/chip_battery.sh) gates every stage with its own
+        # probe; the tools' internal probes would then pay a redundant full
+        # backend init per stage — it exports this to skip them.
+        say("device probe skipped (EDL_SKIP_PROBE=1)")
+        return "skipped"
+    last = ""
+    for attempt in range(1, attempts + 1):
+        t0 = time.time()
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", _PROBE_CODE],
+                capture_output=True,
+                text=True,
+                timeout=timeout_s,
+            )
+        except subprocess.TimeoutExpired:
+            last = f"probe hung {timeout_s:.0f}s (killed)"
+            say(f"device probe {attempt}/{attempts}: {last}")
+            continue  # the hang already consumed the backoff and then some
+        if out.returncode == 0 and out.stdout.strip():
+            summary = out.stdout.strip()
+            say(
+                f"device probe {attempt}/{attempts}: ok in "
+                f"{time.time() - t0:.1f}s ({summary})"
+            )
+            return summary
+        last = (out.stderr.strip() or f"rc={out.returncode}")[-300:]
+        say(f"device probe {attempt}/{attempts}: failed: {last}")
+        if attempt < attempts:
+            time.sleep(backoff_s)
+    raise RuntimeError(
+        f"device probe failed {attempts}x (timeout {timeout_s:.0f}s each); "
+        f"last: {last}"
+    )

@@ -1,0 +1,51 @@
+"""The killable-subprocess device probe (VERDICT r4 Weak #1 / Next #1).
+
+The twice-recorded chip failure mode is a *hang* inside ``jax.devices()``
+(BENCH_r02/r04: phase "init" burned the whole watchdog).  The probe's job is
+to make that survivable: bounded killable attempts, success string on a live
+backend, RuntimeError (not a hang) when the backend never answers.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import pytest
+
+from elasticdl_tpu.common import platform
+
+
+def test_probe_succeeds_on_live_backend():
+    # The subprocess inherits JAX_PLATFORMS=cpu from conftest, so it answers
+    # quickly with the fake-CPU device count.
+    summary = platform.probe_devices(attempts=2, timeout_s=120.0)
+    n, plat = summary.split()
+    assert int(n) >= 1
+    assert plat == "cpu"
+
+
+def test_probe_hang_is_killed_and_bounded(monkeypatch, caplog):
+    # Simulate the observed failure: the probe process never answers.  Each
+    # attempt must be killed at timeout_s and the call must raise instead of
+    # hanging.
+    monkeypatch.setattr(platform, "_PROBE_CODE", "import time; time.sleep(60)")
+    seen = []
+    with pytest.raises(RuntimeError, match="probe failed 2x"):
+        platform.probe_devices(
+            attempts=2, timeout_s=0.5, backoff_s=0.0, log=seen.append
+        )
+    assert len(seen) == 2
+    assert all("hung" in m for m in seen)
+
+
+def test_probe_crash_is_retried_then_raises(monkeypatch):
+    monkeypatch.setattr(
+        platform, "_PROBE_CODE", "import sys; sys.stderr.write('boom'); sys.exit(3)"
+    )
+    seen = []
+    with pytest.raises(RuntimeError, match="boom"):
+        platform.probe_devices(
+            attempts=2, timeout_s=10.0, backoff_s=0.0, log=seen.append
+        )
+    assert len(seen) == 2
+    assert all("boom" in m for m in seen)

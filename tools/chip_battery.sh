@@ -1,19 +1,45 @@
 #!/bin/bash
-# One-shot on-chip measurement battery (round 4).  Run from the repo root
-# with the real TPU reachable; each stage appends its JSON to the log.
-# Stages are ordered headline-first so a mid-battery chip flake still
-# leaves the most important artifacts.  NEVER run two stages concurrently.
+# One-shot on-chip measurement battery (round 4; probe-hardened round 5).
+# Run from the repo root with the real TPU reachable; each stage appends its
+# JSON to the log.  Stages are ordered headline-first so a mid-battery chip
+# flake still leaves the most important artifacts.  NEVER run two stages
+# concurrently.
+#
+# The twice-recorded chip failure mode is a HANG in jax.devices(), which a
+# stage timeout only converts into a 600 s burn per stage.  So: a killable
+# subprocess probe (elasticdl_tpu.common.platform.probe_devices) gates the
+# battery — generous attempts at preflight (chip flaky at minute 0, fine at
+# minute 5 should still yield a full battery), quick re-probe before each
+# later stage so a mid-battery outage skips cleanly instead of eating every
+# remaining stage's timeout.
 set -u
 LOG=${1:-/tmp/chip_battery.log}
 echo "== chip battery $(date -u +%H:%M:%S)" | tee -a "$LOG"
 
+probe() {  # $1 = attempts (x90s each)
+  python -c "from elasticdl_tpu.common.platform import probe_devices as p; p(attempts=$1, timeout_s=90)" >>"$LOG" 2>&1
+}
+
 run() {
-  echo "-- $1" | tee -a "$LOG"
-  shift
-  timeout 600 "$@" 2>>"$LOG" | tee -a "$LOG"
+  local name=$1; shift
+  if ! probe "${PROBE_ATTEMPTS:-3}"; then
+    echo "-- $name SKIPPED: chip unreachable at probe" | tee -a "$LOG"
+    return
+  fi
+  echo "-- $name" | tee -a "$LOG"
+  # The battery's probe above just passed; the tools' internal probes would
+  # pay a redundant backend init each — skip them (platform.probe_devices).
+  EDL_SKIP_PROBE=1 timeout 600 "$@" 2>>"$LOG" | tee -a "$LOG"
   # rc of the benchmarked command, not tee's (124 = timeout kill)
   echo "-- rc=${PIPESTATUS[0]}" | tee -a "$LOG"
 }
+
+# Preflight: be patient once (up to ~12 min of probing) before the first
+# stage; later stages use the quick 3-attempt probe.
+if ! probe 8; then
+  echo "== chip unreachable at preflight; battery aborted" | tee -a "$LOG"
+  exit 3
+fi
 
 run "bench.py (headline: e2e DeepFM)"      python bench.py
 run "bench_all (configs 1-3 + MFU)"        python tools/bench_all.py

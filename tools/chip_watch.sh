@@ -1,0 +1,23 @@
+#!/bin/bash
+# Poll the chip with killable probes until it answers, then exit 0 so the
+# operator (or a wrapper) can fire tools/chip_battery.sh immediately.
+# Exit 4 after --max-minutes of failure.  Log: one line per probe.
+set -u
+MAX_MIN=${1:-600}
+LOG=${2:-/tmp/chip_watch.log}
+start=$(date +%s)
+n=0
+while :; do
+  n=$((n+1))
+  if python -c "from elasticdl_tpu.common.platform import probe_devices as p; p(attempts=1, timeout_s=120)" >>"$LOG" 2>&1; then
+    echo "chip UP at probe $n $(date -u +%H:%M:%S)" | tee -a "$LOG"
+    exit 0
+  fi
+  echo "probe $n: chip down $(date -u +%H:%M:%S)" >> "$LOG"
+  now=$(date +%s)
+  if [ $(( (now - start) / 60 )) -ge "$MAX_MIN" ]; then
+    echo "chip still down after ${MAX_MIN}m; giving up" | tee -a "$LOG"
+    exit 4
+  fi
+  sleep 180
+done

@@ -35,8 +35,12 @@ from tools.bench_e2e import (  # noqa: E402
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--epochs", type=int, default=16)
-    ap.add_argument("--out", default="TRAINJOB_r04.json")
+    ap.add_argument("--out", default="TRAINJOB_r05.json")
     args = ap.parse_args()
+    from elasticdl_tpu.common.platform import probe_devices
+
+    # Hang-proof init: see bench.py (VERDICT r4 Next #1).
+    probe_devices(attempts=3, timeout_s=90)
 
     import tempfile
 
