@@ -63,9 +63,15 @@ def prefetch(iterable: Iterable, depth: int = 2) -> Iterator:
             return
         _put(_DONE)
 
-    threading.Thread(target=_produce, name="edl-prefetch", daemon=True).start()
-
     def _consume() -> Iterator:
+        # Lazy start (ADVICE r4 #1): a generator abandoned before its first
+        # next() never executes its body, so its finally never runs — an
+        # eagerly started producer would then spin on 0.1 s put-retries
+        # forever, pinning ``depth`` decoded batches.  Starting the thread
+        # on the first pull means no pull, no thread, no leak.
+        threading.Thread(
+            target=_produce, name="edl-prefetch", daemon=True
+        ).start()
         try:
             while True:
                 item = q.get()

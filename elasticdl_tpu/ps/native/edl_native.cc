@@ -431,12 +431,24 @@ static inline uint16_t f32_to_f16(float f) {
     if (rem > 0x1000u || (rem == 0x1000u && (half & 1))) half++;
     return (uint16_t)half;
   }
-  if (exp >= 31) return (uint16_t)(sign | 0x7c00u);  // overflow -> inf
+  if (exp >= 31) {
+    // NaN must stay NaN (qNaN), not collapse to inf (ADVICE r4 #2): the
+    // current PRE transform (log1p(max(x,0))) can't produce one, but the
+    // cast must match numpy if that ever changes.
+    if (((x >> 23) & 0xffu) == 0xffu && mant != 0)
+      return (uint16_t)(sign | 0x7e00u);
+    return (uint16_t)(sign | 0x7c00u);  // overflow -> inf
+  }
   uint32_t half = sign | ((uint32_t)exp << 10) | (mant >> 13);
   const uint32_t rem = mant & 0x1fffu;
   if (rem > 0x1000u || (rem == 0x1000u && (half & 1))) half++;
   return (uint16_t)half;
 }
+
+// Test-surface export: the cast's numerics (round-to-nearest-even,
+// subnormals, inf, and the NaN branch no current PRE transform can reach)
+// are verified against numpy's cast in tests/test_host_store.py.
+uint16_t edl_f32_to_f16(float f) { return f32_to_f16(f); }
 
 }  // extern "C" — paused: templates need C++ linkage; resumed below.
 

@@ -412,3 +412,27 @@ def test_prefetch_cancellation_releases_producer():
         time.sleep(0.05)
     assert threading.active_count() <= before, "producer thread leaked"
     assert len(produced) < 1000  # producer stopped early, not drained
+
+
+def test_prefetch_abandoned_before_first_pull_starts_no_thread():
+    """A generator abandoned before its first next() never runs its body, so
+    its finally can't cancel anything — the producer must therefore start
+    lazily on the first pull (ADVICE r4 #1), or it would spin forever."""
+    import gc
+    import threading
+
+    from elasticdl_tpu.data.prefetch import prefetch
+
+    produced = []
+
+    def gen():
+        for i in range(1000):
+            produced.append(i)
+            yield i
+
+    before = threading.active_count()
+    it = prefetch(gen(), depth=2)
+    del it  # abandoned: no next() ever happens
+    gc.collect()
+    assert threading.active_count() <= before, "producer started eagerly"
+    assert produced == []

@@ -141,3 +141,39 @@ def test_native_recordio_scanner_matches_python(tmp_path):
     raw[native_offsets[151] + 8] ^= 0xFF
     open(path, "wb").write(bytes(raw))
     assert recordio_verify_native(path, native_offsets, 0, 200) == 151
+
+
+def test_native_f16_cast_matches_numpy():
+    """The PRE-transform's f32->f16 cast must match numpy bit-for-bit —
+    including NaN, which ADVICE r4 #2 found collapsing to inf (latent: the
+    current log1p(max(x,0)) pipeline can't produce one, but the cast is a
+    general utility and must not lie if the transform changes)."""
+    import ctypes
+    import math
+
+    from elasticdl_tpu.ps import host_store
+
+    lib = host_store._load()
+    lib.edl_f32_to_f16.restype = ctypes.c_uint16
+    lib.edl_f32_to_f16.argtypes = [ctypes.c_float]
+
+    cases = np.array(
+        [0.0, -0.0, 1.0, -1.0, 0.1, 65504.0, 65520.0, 1e9, -1e9,
+         6e-5, 5.96e-8, 1e-10, math.inf, -math.inf, math.nan, -math.nan,
+         2.0009765625, 2.001953125],  # exact-tie rounding cases
+        dtype=np.float32,
+    )
+    rng = np.random.default_rng(0)
+    cases = np.concatenate(
+        [cases, rng.standard_normal(500).astype(np.float32) * 1e3]
+    )
+    expected = cases.astype(np.float16).view(np.uint16)
+    got = np.array(
+        [lib.edl_f32_to_f16(float(v)) for v in cases], dtype=np.uint16
+    )
+    # NaN payloads may differ; require NaN-ness, exact bits elsewhere.
+    nan_mask = np.isnan(cases)
+    np.testing.assert_array_equal(got[~nan_mask], expected[~nan_mask])
+    assert all(
+        (g & 0x7C00) == 0x7C00 and (g & 0x03FF) != 0 for g in got[nan_mask]
+    )
