@@ -325,7 +325,7 @@ class Master:
             from elasticdl_tpu.master.pod_manager import FakePodBackend
 
             return FakePodBackend()
-        return ProcessPodBackend()
+        return ProcessPodBackend(warm_standby=config.warm_worker_standby)
 
     # Pod death cascades: membership bump -> servicer listener requeues tasks.
     def _on_pod_event(self, pod_name: str, phase: str) -> None:

@@ -138,13 +138,24 @@ class FakePodBackend(PodBackend):
 
 class ProcessPodBackend(PodBackend):
     """Worker pods as local subprocesses; a watcher thread maps exit codes to
-    pod events.  ``argv`` defaults to the worker main module."""
+    pod events.  ``argv`` defaults to the worker main module.
+
+    ``warm_standby=True`` keeps ONE pre-booted spare parked: a process that
+    has already paid python + jax + framework imports (~13 s of the r4
+    25.7 s re-rendezvous, docs/perf.md) and waits on a go-file for its
+    worker id (worker.main standby mode).  ``start_pod`` adopts the spare
+    when its environment matches and immediately spawns a replacement, so a
+    relaunch boots in restore+compile time instead of import time.  A
+    second failure inside the replacement window falls back to a cold
+    spawn — the spare is a latency optimization, never a correctness
+    dependency."""
 
     def __init__(
         self,
         argv: Optional[List[str]] = None,
         poll_interval_s: float = 0.2,
         inherit_env: bool = True,
+        warm_standby: bool = False,
     ):
         self._argv = argv or [sys.executable, "-m", "elasticdl_tpu.worker.main"]
         self._procs: Dict[str, subprocess.Popen] = {}
@@ -153,11 +164,109 @@ class ProcessPodBackend(PodBackend):
         self._inherit = inherit_env
         self._stop = threading.Event()
         self._watcher: Optional[threading.Thread] = None
+        self._warm = warm_standby
+        # (proc, go_file, env_signature) of the parked spare, if any.
+        self._standby: Optional[tuple] = None
+        self._standby_dir: Optional[str] = None
+        self._standby_seq = 0
+
+    #: Per-pod identity env: excluded from the spawn-time signature and
+    #: delivered via the go file at adoption instead, so ONE spare serves a
+    #: relaunch of ANY slot/id of the job (review r5: including
+    #: ELASTICDL_WORKER_SLOT in the signature silently limited adoption to
+    #: the last-started slot and churned the spare on every other launch).
+    _IDENTITY_KEYS = ("ELASTICDL_WORKER_ID", "ELASTICDL_WORKER_SLOT")
+
+    @classmethod
+    def _env_sig(cls, full_env: Dict[str, str]) -> tuple:
+        return tuple(
+            sorted(
+                (k, v)
+                for k, v in full_env.items()
+                if k not in cls._IDENTITY_KEYS + ("ELASTICDL_STANDBY_GO_FILE",)
+            )
+        )
+
+    def _adopt_standby(self, name: str, full_env: Dict[str, str]):
+        """Hand the parked spare its identity; None if no matching spare."""
+        import json
+
+        with self._lock:
+            if self._standby is None:
+                return None
+            proc, go_file, sig = self._standby
+            if sig != self._env_sig(full_env) or proc.poll() is not None:
+                self._standby = None
+                if proc.poll() is None:
+                    proc.kill()
+                return None
+            self._standby = None
+        # Atomic publish: the standby polls for existence, so the content
+        # must be complete the moment the path appears.
+        payload = {
+            "worker_id": name,
+            "env": {
+                k: full_env[k]
+                for k in self._IDENTITY_KEYS
+                if k in full_env and k != "ELASTICDL_WORKER_ID"
+            },
+        }
+        tmp = go_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, go_file)
+        logger.info("adopted warm standby (pid %d) as %s", proc.pid, name)
+        return proc
+
+    def _spawn_standby(self, full_env: Dict[str, str]) -> None:
+        """Park one spare for the NEXT relaunch (no-op if one is live)."""
+        import tempfile
+
+        sig = self._env_sig(full_env)
+        with self._lock:
+            if self._standby is not None:
+                proc, _, sig0 = self._standby
+                if sig0 == sig and proc.poll() is None:
+                    return
+                if proc.poll() is None:
+                    proc.kill()
+                self._standby = None
+            if self._standby_dir is None:
+                self._standby_dir = tempfile.mkdtemp(prefix="edl_standby_")
+            self._standby_seq += 1
+            go_file = os.path.join(
+                self._standby_dir, f"go.{self._standby_seq}"
+            )
+        env = {
+            k: v
+            for k, v in full_env.items()
+            if k not in self._IDENTITY_KEYS
+        }
+        env["ELASTICDL_STANDBY_GO_FILE"] = go_file
+        proc = subprocess.Popen(self._argv, env=env)
+        with self._lock:
+            # Popen ran outside the lock, so a concurrent start_pod (e.g.
+            # scale() on the main thread racing a relaunch on the watcher
+            # thread) may have parked its own spare meanwhile — keeping
+            # both would orphan one forever (review r5): exactly one wins.
+            if self._standby is not None:
+                other, _, osig = self._standby
+                if osig == sig and other.poll() is None:
+                    proc.kill()  # lost the race; the parked spare stands
+                    return
+                if other.poll() is None:
+                    other.kill()
+            self._standby = (proc, go_file, sig)
+        logger.info("warm standby parked (pid %d)", proc.pid)
 
     def start_pod(self, name: str, env: Dict[str, str]) -> None:
         full_env = dict(os.environ) if self._inherit else {}
         full_env.update(env)
-        proc = subprocess.Popen(self._argv, env=full_env)
+        proc = self._adopt_standby(name, full_env) if self._warm else None
+        if proc is None:
+            proc = subprocess.Popen(self._argv, env=full_env)
+        if self._warm:
+            self._spawn_standby(full_env)
         with self._lock:
             self._procs[name] = proc
             if self._watcher is None:
@@ -213,9 +322,17 @@ class ProcessPodBackend(PodBackend):
         with self._lock:
             procs = list(self._procs.values())
             self._procs.clear()
+            if self._standby is not None:
+                procs.append(self._standby[0])
+                self._standby = None
+            standby_dir, self._standby_dir = self._standby_dir, None
         for proc in procs:
             if proc.poll() is None:
                 proc.kill()
+        if standby_dir is not None:
+            import shutil
+
+            shutil.rmtree(standby_dir, ignore_errors=True)
 
 
 def render_base_pod_manifest(

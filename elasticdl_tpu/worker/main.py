@@ -41,8 +41,13 @@ logger = get_logger("worker.main")
 # version to hold still this long (bounded by the max) before fixing the
 # jax.distributed world.  Workers of one gang start near-simultaneously; the
 # first to register would otherwise derive a world of 1 and pay a full
-# process restart the moment the second joins.
-SETTLE_STABLE_S = 2.0
+# process restart the moment the second joins.  Sampled at SETTLE_POLL_S so
+# the wait costs ~the stability window itself, not a fixed sleep — the
+# settle is on the relaunch critical path (docs/perf.md re-rendezvous), and
+# a missed race now costs one CHEAP restart (warm standby + death push)
+# rather than a cold boot.
+SETTLE_STABLE_S = 1.0
+SETTLE_POLL_S = 0.25
 SETTLE_MAX_S = 15.0
 
 
@@ -64,6 +69,47 @@ def build_job_reader(config: JobConfig) -> AbstractDataReader:
     return readers[0] if len(readers) == 1 else CompositeDataReader(readers)
 
 
+def _park_as_standby(go_file: str) -> str:
+    """Warm-standby mode (ELASTICDL_STANDBY_GO_FILE): pre-pay the boot tail
+    — python + jax + framework imports, ~13 s of the r4 re-rendezvous
+    (docs/perf.md) — then park until the pod manager writes the go file
+    naming the worker id this process should become.  Nothing here may
+    touch a jax *backend* (devices/compile): in multihost mode the backend
+    must first bind to the jax.distributed world formed AFTER registration.
+    Returns the assigned worker id."""
+    import importlib
+
+    for mod in (
+        "jax", "jax.numpy", "flax", "optax", "orbax.checkpoint",
+        "elasticdl_tpu.parallel.trainer", "elasticdl_tpu.parallel.mesh",
+        "elasticdl_tpu.models.spec", "elasticdl_tpu.data.reader",
+        "elasticdl_tpu.worker.worker",
+    ):
+        importlib.import_module(mod)
+    logger.info("standby warmed (pid %d); parking on %s", os.getpid(), go_file)
+    parent0 = os.getppid()
+    while not os.path.exists(go_file):
+        if os.getppid() != parent0:
+            # The master died without close() (kill -9/OOM): nothing will
+            # ever write the go file — exit instead of parking a jax-loaded
+            # interpreter forever (review r5).
+            logger.info("standby orphaned (parent gone); exiting")
+            raise SystemExit(0)
+        time.sleep(0.05)
+    import json
+
+    # JSON payload: the worker id plus per-pod identity env the backend
+    # withheld at spawn time so one spare serves any slot (ProcessPodBackend
+    # _IDENTITY_KEYS) — e.g. ELASTICDL_WORKER_SLOT, which
+    # parallel/distributed.py reads for coordinator selection.
+    payload = json.loads(open(go_file).read())
+    for k, v in payload.get("env", {}).items():
+        os.environ[k] = v
+    worker_id = payload["worker_id"]
+    logger.info("standby adopted as %s", worker_id)
+    return worker_id
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     try:
         config = JobConfig.from_env()
@@ -74,7 +120,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     from elasticdl_tpu.common.log_utils import set_level
 
     set_level(config.log_level)
-    worker_id = os.environ.get("ELASTICDL_WORKER_ID", f"worker-{os.getpid()}")
+    go_file = os.environ.get("ELASTICDL_STANDBY_GO_FILE", "")
+    if go_file:
+        worker_id = _park_as_standby(go_file)
+    else:
+        worker_id = os.environ.get(
+            "ELASTICDL_WORKER_ID", f"worker-{os.getpid()}"
+        )
     logger.info("worker %s booting (pid %d)", worker_id, os.getpid())
     # Persistent XLA compile cache: every elastic re-join re-jits the train
     # step for its (program, topology); relaunched incarnations load the
@@ -110,13 +162,30 @@ def main(argv: Optional[List[str]] = None) -> int:
     # and long steps must not look like death to the master's reaper.  The
     # loop's own Heartbeat calls still drive version-change detection.
     hb_stop = threading.Event()
+    # Set once the Worker exists; the beat thread then doubles as the
+    # DEATH-PUSH receiver (Worker.death_watch_tick): a survivor blocked in
+    # a collective on a dead peer force-exits RESTART within ~grace seconds
+    # of the master's eviction instead of waiting out the coordination
+    # heartbeat (--distributed_heartbeat_timeout_s).
+    worker_holder: dict = {}
 
     def _beat() -> None:
-        while not hb_stop.wait(1.0):
+        dw_state: dict = {"pending_since": None}
+        while not hb_stop.wait(0.25 if dw_state["pending_since"] else 1.0):
             try:
                 master.call("Heartbeat", {"worker_id": worker_id})
             except Exception:  # master briefly unreachable: retry next beat
                 pass
+            w = worker_holder.get("worker")
+            if w is None:
+                continue
+            try:
+                if w.death_watch_tick(dw_state, time.time()):
+                    sys.stderr.flush()
+                    sys.stdout.flush()
+                    os._exit(RESTART_EXIT_CODE)
+            except Exception:
+                logger.exception("death watch tick failed; will retry")
 
     threading.Thread(target=_beat, daemon=True, name="heartbeat").start()
     logger.info(
@@ -126,12 +195,15 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if config.multihost:
         deadline = time.time() + SETTLE_MAX_S
+        stable_since = time.time()
         while time.time() < deadline:
-            time.sleep(SETTLE_STABLE_S)
+            time.sleep(SETTLE_POLL_S)
             current = master.call("GetMembership", {})
-            if current["version"] == membership["version"]:
+            if current["version"] != membership["version"]:
+                membership = current
+                stable_since = time.time()
+            elif time.time() - stable_since >= SETTLE_STABLE_S:
                 break
-            membership = current
         spec = distributed.spec_from_membership(
             membership,
             worker_id,
@@ -142,6 +214,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     worker = Worker(
         config, master, build_job_reader(config), worker_id=worker_id
     )
+    worker_holder["worker"] = worker
     try:
         result = worker.run(membership=membership)
     except WorkerRestartRequired as e:

@@ -341,3 +341,90 @@ def test_run_watch_loop_reestablishes_and_feeds_slots():
     assert ("w0", PodPhase.RUNNING) in seen
     assert ("w0", PodPhase.RESTART) in seen
     assert len(rounds) == 2
+
+
+# ---------------------------------------------------------------------------
+# Warm-standby spare (VERDICT r4 Next #4b): the backend parks one pre-booted
+# process and hands it its worker id via the go-file at relaunch time.
+# ---------------------------------------------------------------------------
+
+STANDBY_STUB = """
+import json, os, sys, time
+go = os.environ.get("ELASTICDL_STANDBY_GO_FILE")
+out = os.environ["STANDBY_TEST_OUT"]
+if go:
+    while not os.path.exists(go):
+        time.sleep(0.01)
+    payload = json.loads(open(go).read())
+    for k, v in payload.get("env", {}).items():
+        os.environ[k] = v
+    wid = payload["worker_id"]
+    mode = "warm"
+else:
+    wid = os.environ["ELASTICDL_WORKER_ID"]
+    mode = "cold"
+slot = os.environ.get("ELASTICDL_WORKER_SLOT", "?")
+with open(os.path.join(out, f"ran.{wid}"), "w") as f:
+    f.write(f"{mode}:{os.getpid()}:{slot}")
+time.sleep(60)  # stay 'running' like a real worker
+"""
+
+
+def _wait(cond, timeout=15.0, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_warm_standby_adopted_on_relaunch(tmp_path):
+    script = tmp_path / "stub.py"
+    script.write_text(STANDBY_STUB)
+    backend = ProcessPodBackend(
+        argv=[sys.executable, str(script)], warm_standby=True
+    )
+    def env(name, slot, **extra):
+        # Mirrors PodManager._pod_env: per-pod identity + job-static env.
+        return {
+            "ELASTICDL_WORKER_ID": name,
+            "ELASTICDL_WORKER_SLOT": str(slot),
+            "STANDBY_TEST_OUT": str(tmp_path),
+            **extra,
+        }
+
+    try:
+        backend.start_pod("w-0", env("w-0", 0))  # cold (no spare) + parks one
+        _wait(lambda: (tmp_path / "ran.w-0").exists(), what="w-0 boot")
+        assert (tmp_path / "ran.w-0").read_text().split(":") [::2] == [
+            "cold", "0",
+        ]
+        _wait(lambda: backend._standby is not None, what="spare parked")
+        spare_pid = backend._standby[0].pid
+
+        # Adoption works across SLOTS (review r5: per-pod slot must ride the
+        # go file, not the spawn signature) — relaunch slot 1 from the spare
+        # parked by slot 0's launch.
+        backend.start_pod("w-1", env("w-1", 1))
+        _wait(lambda: (tmp_path / "ran.w-1").exists(), what="w-1 adoption")
+        mode, pid, slot = (tmp_path / "ran.w-1").read_text().split(":")
+        assert (mode, slot) == ("warm", "1") and int(pid) == spare_pid
+        # A replacement spare was parked for the NEXT relaunch.
+        _wait(
+            lambda: backend._standby is not None
+            and backend._standby[0].pid != spare_pid,
+            what="replacement spare",
+        )
+
+        # Job-static env change invalidates the spare: next launch is cold.
+        backend.start_pod("w-2", env("w-2", 2, EXTRA="x"))
+        _wait(lambda: (tmp_path / "ran.w-2").exists(), what="w-2 boot")
+        assert (tmp_path / "ran.w-2").read_text().startswith("cold:")
+        standby_dir = backend._standby_dir
+        assert standby_dir is not None and os.path.isdir(standby_dir)
+    finally:
+        backend.close()
+    # close() reaps the spare AND its scratch dir — nothing outlives the job.
+    assert backend._standby is None
+    assert not os.path.isdir(standby_dir)
