@@ -186,6 +186,16 @@ class JobConfig:
     log_level: str = "INFO"
     profile_dir: str = ""  # worker: jax.profiler trace of one training task
     metrics_dir: str = ""  # master: JSONL + TensorBoard scalar stream
+    # Process backend: capture each worker pod's stdout+stderr to
+    # {pod_log_dir}/{pod-name}.log (the local analog of kubectl logs; pod
+    # names are unique per incarnation, so one file per life).  "" =
+    # inherit the master's stdio.
+    pod_log_dir: str = ""
+    # Spares kept parked when --warm_worker_standby: 1 covers a lone
+    # relaunch; a peer-death recovery relaunches TWO processes (the dead
+    # pod + the survivor's RESTART), so multihost fleets that want the
+    # whole recovery warm use 2.  Each spare holds one idle interpreter.
+    standby_pool: int = 1
 
     # --- precision ---
     compute_dtype: str = "bfloat16"  # MXU-native; params stay f32

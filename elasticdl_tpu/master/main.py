@@ -325,7 +325,11 @@ class Master:
             from elasticdl_tpu.master.pod_manager import FakePodBackend
 
             return FakePodBackend()
-        return ProcessPodBackend(warm_standby=config.warm_worker_standby)
+        return ProcessPodBackend(
+            warm_standby=config.warm_worker_standby,
+            standby_pool=config.standby_pool,
+            log_dir=config.pod_log_dir or None,
+        )
 
     # Pod death cascades: membership bump -> servicer listener requeues tasks.
     def _on_pod_event(self, pod_name: str, phase: str) -> None:
@@ -334,6 +338,10 @@ class Master:
 
     def scale(self, n: int) -> None:
         """Elastic resize (the 4->8->4 path): grow/shrink the worker fleet."""
+        # The rendezvous learns the target FIRST so workers registering
+        # during the resize wait for the full gang instead of forming
+        # worlds one member at a time (worker.main settle loop).
+        self.rendezvous.set_expected(n)
         self.pod_manager.scale(n)
 
     def run(self, poll_interval_s: float = 0.2, reap_every_s: float = 5.0) -> Dict:
@@ -348,6 +356,7 @@ class Master:
                 # tear down the pods already launched.
                 self.ps_manager.start(self.config.num_ps_pods)
                 self._wait_ps_ready()
+            self.rendezvous.set_expected(self.config.num_workers)
             self.pod_manager.start()
             while not self.servicer.job_finished():
                 now = time.monotonic()

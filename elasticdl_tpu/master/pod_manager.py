@@ -140,15 +140,17 @@ class ProcessPodBackend(PodBackend):
     """Worker pods as local subprocesses; a watcher thread maps exit codes to
     pod events.  ``argv`` defaults to the worker main module.
 
-    ``warm_standby=True`` keeps ONE pre-booted spare parked: a process that
-    has already paid python + jax + framework imports (~13 s of the r4
-    25.7 s re-rendezvous, docs/perf.md) and waits on a go-file for its
-    worker id (worker.main standby mode).  ``start_pod`` adopts the spare
-    when its environment matches and immediately spawns a replacement, so a
-    relaunch boots in restore+compile time instead of import time.  A
-    second failure inside the replacement window falls back to a cold
-    spawn — the spare is a latency optimization, never a correctness
-    dependency."""
+    ``warm_standby=True`` keeps a small POOL of pre-booted spares parked:
+    processes that have already paid python + jax + framework imports
+    (~13 s of the r4 25.7 s re-rendezvous, docs/perf.md) and wait on a
+    go-file for their worker id (worker.main standby mode).  ``start_pod``
+    adopts a spare when its environment matches and immediately refills
+    the pool, so a relaunch boots in restore+compile time instead of
+    import time.  ``standby_pool`` sizes it: 1 covers a lone failure; a
+    peer-death recovery relaunches TWO processes (the dead pod plus the
+    survivor's RESTART), so fleets that want both warm park 2.  A failure
+    burst beyond the pool falls back to cold spawns — spares are a latency
+    optimization, never a correctness dependency."""
 
     def __init__(
         self,
@@ -156,6 +158,8 @@ class ProcessPodBackend(PodBackend):
         poll_interval_s: float = 0.2,
         inherit_env: bool = True,
         warm_standby: bool = False,
+        standby_pool: int = 1,
+        log_dir: Optional[str] = None,
     ):
         self._argv = argv or [sys.executable, "-m", "elasticdl_tpu.worker.main"]
         self._procs: Dict[str, subprocess.Popen] = {}
@@ -165,10 +169,22 @@ class ProcessPodBackend(PodBackend):
         self._stop = threading.Event()
         self._watcher: Optional[threading.Thread] = None
         self._warm = warm_standby
-        # (proc, go_file, env_signature) of the parked spare, if any.
-        self._standby: Optional[tuple] = None
+        self._pool_size = max(1, standby_pool)
+        # Per-pod log capture (the process-backend analog of kubectl logs):
+        # each pod's stdout+stderr goes to {log_dir}/{name}.log.  Pod names
+        # are already unique per incarnation (PodManager's -rN suffix), so
+        # no extra counter is needed.  None = inherit the parent's stdio.
+        self._log_dir = log_dir
+        # Parked spares: [(proc, go_file, env_signature)].
+        self._standby: List[tuple] = []
         self._standby_dir: Optional[str] = None
         self._standby_seq = 0
+
+    def _pod_stdio(self, name: str):
+        if self._log_dir is None:
+            return None
+        os.makedirs(self._log_dir, exist_ok=True)
+        return open(os.path.join(self._log_dir, f"{name}.log"), "w")
 
     #: Per-pod identity env: excluded from the spawn-time signature and
     #: delivered via the go file at adoption instead, so ONE spare serves a
@@ -187,20 +203,28 @@ class ProcessPodBackend(PodBackend):
             )
         )
 
+    def _prune_spares_locked(self, sig) -> None:
+        """Drop dead spares; kill + drop spares whose job env changed."""
+        keep = []
+        for proc, go_file, s in self._standby:
+            if proc.poll() is not None:
+                continue
+            if s != sig:
+                proc.kill()
+                continue
+            keep.append((proc, go_file, s))
+        self._standby = keep
+
     def _adopt_standby(self, name: str, full_env: Dict[str, str]):
-        """Hand the parked spare its identity; None if no matching spare."""
+        """Hand a parked spare its identity; None if no matching spare."""
         import json
 
+        sig = self._env_sig(full_env)
         with self._lock:
-            if self._standby is None:
+            self._prune_spares_locked(sig)
+            if not self._standby:
                 return None
-            proc, go_file, sig = self._standby
-            if sig != self._env_sig(full_env) or proc.poll() is not None:
-                self._standby = None
-                if proc.poll() is None:
-                    proc.kill()
-                return None
-            self._standby = None
+            proc, go_file, _ = self._standby.pop(0)
         # Atomic publish: the standby polls for existence, so the content
         # must be complete the moment the path appears.
         payload = {
@@ -215,58 +239,81 @@ class ProcessPodBackend(PodBackend):
         with open(tmp, "w") as f:
             json.dump(payload, f)
         os.replace(tmp, go_file)
+        if self._log_dir is not None:
+            # The spare's stdio was bound at spawn (it cannot be
+            # redirected now); keep the per-pod-life log contract by
+            # symlinking the pod name to the spare's file — the relaunch's
+            # log is the one an operator needs most (review r5).
+            spare_log = f"standby.{os.path.basename(go_file)}.log"
+            link = os.path.join(self._log_dir, f"{name}.log")
+            try:
+                os.symlink(spare_log, link)
+            except OSError:
+                logger.warning("could not link %s -> %s", link, spare_log)
         logger.info("adopted warm standby (pid %d) as %s", proc.pid, name)
         return proc
 
-    def _spawn_standby(self, full_env: Dict[str, str]) -> None:
-        """Park one spare for the NEXT relaunch (no-op if one is live)."""
+    def _fill_standby_pool(self, full_env: Dict[str, str]) -> None:
+        """Top the pool up to ``standby_pool`` live same-env spares."""
         import tempfile
 
         sig = self._env_sig(full_env)
-        with self._lock:
-            if self._standby is not None:
-                proc, _, sig0 = self._standby
-                if sig0 == sig and proc.poll() is None:
+        while True:
+            with self._lock:
+                self._prune_spares_locked(sig)
+                if len(self._standby) >= self._pool_size:
                     return
-                if proc.poll() is None:
-                    proc.kill()
-                self._standby = None
-            if self._standby_dir is None:
-                self._standby_dir = tempfile.mkdtemp(prefix="edl_standby_")
-            self._standby_seq += 1
-            go_file = os.path.join(
-                self._standby_dir, f"go.{self._standby_seq}"
-            )
-        env = {
-            k: v
-            for k, v in full_env.items()
-            if k not in self._IDENTITY_KEYS
-        }
-        env["ELASTICDL_STANDBY_GO_FILE"] = go_file
-        proc = subprocess.Popen(self._argv, env=env)
-        with self._lock:
-            # Popen ran outside the lock, so a concurrent start_pod (e.g.
-            # scale() on the main thread racing a relaunch on the watcher
-            # thread) may have parked its own spare meanwhile — keeping
-            # both would orphan one forever (review r5): exactly one wins.
-            if self._standby is not None:
-                other, _, osig = self._standby
-                if osig == sig and other.poll() is None:
-                    proc.kill()  # lost the race; the parked spare stands
+                if self._standby_dir is None:
+                    self._standby_dir = tempfile.mkdtemp(
+                        prefix="edl_standby_"
+                    )
+                self._standby_seq += 1
+                go_file = os.path.join(
+                    self._standby_dir, f"go.{self._standby_seq}"
+                )
+            env = {
+                k: v
+                for k, v in full_env.items()
+                if k not in self._IDENTITY_KEYS
+            }
+            env["ELASTICDL_STANDBY_GO_FILE"] = go_file
+            log = self._pod_stdio(f"standby.{os.path.basename(go_file)}")
+            try:
+                proc = subprocess.Popen(
+                    self._argv, env=env, stdout=log,
+                    stderr=subprocess.STDOUT if log else None,
+                )
+            finally:
+                if log is not None:
+                    log.close()  # the child keeps its own fd
+            with self._lock:
+                # Popen ran outside the lock, so a concurrent start_pod
+                # (scale() on the main thread racing a relaunch on the
+                # watcher thread) may have topped the pool up meanwhile —
+                # an over-full pool would orphan the extras (review r5).
+                self._prune_spares_locked(sig)
+                if len(self._standby) >= self._pool_size:
+                    proc.kill()  # lost the race; the pool is already full
                     return
-                if other.poll() is None:
-                    other.kill()
-            self._standby = (proc, go_file, sig)
-        logger.info("warm standby parked (pid %d)", proc.pid)
+                self._standby.append((proc, go_file, sig))
+            logger.info("warm standby parked (pid %d)", proc.pid)
 
     def start_pod(self, name: str, env: Dict[str, str]) -> None:
         full_env = dict(os.environ) if self._inherit else {}
         full_env.update(env)
         proc = self._adopt_standby(name, full_env) if self._warm else None
         if proc is None:
-            proc = subprocess.Popen(self._argv, env=full_env)
+            log = self._pod_stdio(name)
+            try:
+                proc = subprocess.Popen(
+                    self._argv, env=full_env, stdout=log,
+                    stderr=subprocess.STDOUT if log else None,
+                )
+            finally:
+                if log is not None:
+                    log.close()
         if self._warm:
-            self._spawn_standby(full_env)
+            self._fill_standby_pool(full_env)
         with self._lock:
             self._procs[name] = proc
             if self._watcher is None:
@@ -322,9 +369,8 @@ class ProcessPodBackend(PodBackend):
         with self._lock:
             procs = list(self._procs.values())
             self._procs.clear()
-            if self._standby is not None:
-                procs.append(self._standby[0])
-                self._standby = None
+            procs.extend(p for p, _, _ in self._standby)
+            self._standby = []
             standby_dir, self._standby_dir = self._standby_dir, None
         for proc in procs:
             if proc.poll() is None:

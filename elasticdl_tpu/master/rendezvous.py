@@ -40,6 +40,14 @@ class RendezvousServer:
         self._timeout = heartbeat_timeout_s
         self._clock = clock
         self._listeners: List[Callable[[int, List[str]], None]] = []
+        # DESIRED world size (the pod manager's fleet target; 0 = unknown).
+        # Workers' multihost settle loop forms the world the moment the
+        # full expected gang is registered instead of heuristically waiting
+        # for version stability — without it, staggered relaunches after a
+        # failure form worlds one member at a time, and every late
+        # registration restarts everyone who already formed (measured: a
+        # 2-pod fleet recovery churned for 54 s on the 1-core harness).
+        self._expected = 0
 
     def add_listener(self, fn: Callable[[int, List[str]], None]) -> None:
         """fn(version, sorted_worker_ids) fires on every membership change."""
@@ -141,6 +149,11 @@ class RendezvousServer:
         self._notify(version, members)
         return dead
 
+    def set_expected(self, n: int) -> None:
+        """Record the fleet's desired size (master wires scale() here)."""
+        with self._lock:
+            self._expected = max(0, int(n))
+
     def membership(self) -> dict:
         """The worker-visible view: version + deterministic rank assignment."""
         with self._lock:
@@ -150,6 +163,18 @@ class RendezvousServer:
                 "workers": members,
                 "ranks": {w: i for i, w in enumerate(members)},
                 "world_size": len(members),
+                "expected": self._expected,
+                # Per-member confirmed version (registration or versioned
+                # heartbeat).  The settle loop forms the jax.distributed
+                # world only when every member confirms the CURRENT
+                # version: a stale incarnation (live but about to restart)
+                # can't confirm, so fresh relaunches wait for each other
+                # instead of forming worlds with members that are leaving.
+                "confirmed": {
+                    w: self._confirmed[w]
+                    for w in members
+                    if w in self._confirmed
+                },
                 "addresses": {
                     w: self._addresses[w] for w in members if w in self._addresses
                 },

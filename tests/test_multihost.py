@@ -347,11 +347,14 @@ def test_real_process_scale_4_8_4(tmp_path):
         # The r4 fused-scan loop saturates the core; a solo incumbent then
         # starves the JOINER's cold compile past XLA:CPU's hard 30 s Gloo
         # context-init window, collapsing every world formation on this
-        # 1-core harness.  The per-batch path (prefetch_depth=0) leaves the
-        # scheduler slack the join needs; the fused path's multi-process
-        # correctness is covered by test_two_process_distributed_train_
-        # kill_resume, where the gang compiles symmetrically.
+        # 1-core harness.  The per-batch path leaves the scheduler slack
+        # the join needs; the fused path's multi-process correctness is
+        # covered by test_two_process_distributed_train_kill_resume, where
+        # the gang compiles symmetrically.  (r5: said directly via the
+        # dedicated flag — prefetch_depth=0 no longer implies it.)
         prefetch_depth=0,
+        fused_task_scan=False,
+        task_pipelining=False,
     )
     procs: dict = {}
 

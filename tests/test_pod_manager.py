@@ -400,8 +400,8 @@ def test_warm_standby_adopted_on_relaunch(tmp_path):
         assert (tmp_path / "ran.w-0").read_text().split(":") [::2] == [
             "cold", "0",
         ]
-        _wait(lambda: backend._standby is not None, what="spare parked")
-        spare_pid = backend._standby[0].pid
+        _wait(lambda: len(backend._standby) == 1, what="spare parked")
+        spare_pid = backend._standby[0][0].pid
 
         # Adoption works across SLOTS (review r5: per-pod slot must ride the
         # go file, not the spawn signature) — relaunch slot 1 from the spare
@@ -412,8 +412,8 @@ def test_warm_standby_adopted_on_relaunch(tmp_path):
         assert (mode, slot) == ("warm", "1") and int(pid) == spare_pid
         # A replacement spare was parked for the NEXT relaunch.
         _wait(
-            lambda: backend._standby is not None
-            and backend._standby[0].pid != spare_pid,
+            lambda: len(backend._standby) == 1
+            and backend._standby[0][0].pid != spare_pid,
             what="replacement spare",
         )
 
@@ -425,6 +425,7 @@ def test_warm_standby_adopted_on_relaunch(tmp_path):
         assert standby_dir is not None and os.path.isdir(standby_dir)
     finally:
         backend.close()
-    # close() reaps the spare AND its scratch dir — nothing outlives the job.
-    assert backend._standby is None
+    # close() reaps the spares AND their scratch dir — nothing outlives
+    # the job.
+    assert backend._standby == []
     assert not os.path.isdir(standby_dir)

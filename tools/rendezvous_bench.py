@@ -253,5 +253,159 @@ def main() -> None:
         server.stop()
 
 
+def main_pod() -> None:
+    """Scenario B — the PRODUCTION detection + recovery path.
+
+    Scenario A (``main``) measures the heartbeat-evicted degrade-to-1 path
+    with hand-spawned processes.  Here the fleet runs under the real
+    ``PodManager`` + ``ProcessPodBackend(warm_standby=True)`` exactly as
+    ``elasticdl train`` wires it: the backend's watcher turns the SIGKILL
+    into a FAILED pod event in ~a poll interval (0.2 s) — no heartbeat
+    wait — the listener cascades it into the rendezvous eviction, the
+    manager relaunches the slot (adopting the warm spare), the survivor's
+    death push restarts it into the new world, and the job is RECOVERED
+    when the 2-process world is training again.  Artifact:
+    ``artifacts/rendezvous_pod_r05.json``.
+    """
+    import tempfile
+
+    from elasticdl_tpu.common.config import JobConfig
+    from elasticdl_tpu.data.synthetic import generate
+    from elasticdl_tpu.data.reader import create_data_reader
+    from elasticdl_tpu.master.pod_manager import (
+        PodManager,
+        PodPhase,
+        ProcessPodBackend,
+    )
+    from elasticdl_tpu.master.rendezvous import RendezvousServer
+    from elasticdl_tpu.master.servicer import MasterServer, MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+
+    tmp = tempfile.mkdtemp(prefix="rdzv_pod_")
+    path = os.path.join(tmp, "train.rio")
+    generate("mnist", path, 256)
+    shards = create_data_reader(path).create_shards(32)
+    dispatcher = TaskDispatcher(shards, num_epochs=500)
+    rendezvous = RendezvousServer(heartbeat_timeout_s=3.0)
+    rendezvous.set_expected(2)  # as Master.run does before starting pods
+    servicer = MasterServicer(dispatcher, rendezvous=rendezvous)
+    server = MasterServer(servicer, port=0).start()
+    stop = threading.Event()
+
+    def reap():
+        while not stop.is_set():
+            rendezvous.reap_dead()
+            time.sleep(0.1)
+
+    threading.Thread(target=reap, daemon=True).start()
+
+    config = JobConfig(
+        model_def="mnist.model_spec",
+        model_params="compute_dtype=float32",
+        training_data=path,
+        minibatch_size=16,
+        master_addr=server.address,
+        multihost=True,
+        coordinator_port=_free_port(),
+        checkpoint_dir=os.path.join(tmp, "ckpt"),
+        checkpoint_steps=4,
+        num_epochs=500,
+        num_workers=2,
+        warm_worker_standby=True,
+        distributed_heartbeat_timeout_s=10.0,
+    )
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    # Pool of 2: a peer-death recovery relaunches the dead pod AND the
+    # survivor (its RESTART exit) — both should boot warm.
+    backend = ProcessPodBackend(warm_standby=True, standby_pool=2, log_dir=tmp)
+    manager = PodManager(
+        backend,
+        config,
+        worker_env={
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        },
+    )
+    # master/main.py's wiring: terminal pod -> rendezvous eviction.
+    manager.add_listener(
+        lambda name, phase: rendezvous.remove(name)
+        if phase in PodPhase.TERMINAL
+        else None
+    )
+
+    def wait_for(cond, deadline_s, what):
+        deadline = time.time() + deadline_s
+        while time.time() < deadline:
+            if cond():
+                return time.time()
+            time.sleep(0.02)
+        raise RuntimeError(f"timed out waiting for {what}")
+
+    log = lambda m: print(f"[rdzv-pod] {m}", file=sys.stderr, flush=True)
+    try:
+        manager.start(2)
+        wait_for(
+            lambda: rendezvous.membership()["world_size"] == 2
+            and servicer.JobStatus({})["done"] >= 2,
+            300, "2-pod world making progress",
+        )
+        victim = manager.live_pods()[-1]
+        pid = backend.pid(victim)
+        version0 = rendezvous.membership()["version"]
+        log(f"2-pod world training; SIGKILL {victim} (pid {pid})")
+        t_kill = time.time()
+        os.kill(pid, signal.SIGKILL)
+
+        t_evict = wait_for(
+            lambda: rendezvous.membership()["version"] != version0
+            and victim not in rendezvous.membership()["workers"],
+            60, "pod-event eviction",
+        )
+        log(f"evicted after {t_evict - t_kill:.2f}s (pod event, not heartbeat)")
+
+        done_mark = servicer.JobStatus({})["done"]
+        t_rec = wait_for(
+            lambda: rendezvous.membership()["world_size"] == 2
+            and servicer.JobStatus({})["done"] > done_mark,
+            240, "2-process world training again",
+        )
+        log(f"full fleet recovered {t_rec - t_evict:.2f}s after eviction")
+
+        result = {
+            "metric": "pod_event_full_recovery_s",
+            "kill_to_eviction_s": round(t_evict - t_kill, 2),
+            "eviction_to_recovered_s": round(t_rec - t_evict, 2),
+            "total_s": round(t_rec - t_kill, 2),
+            "note": "PodManager + ProcessPodBackend(warm_standby) fleet; "
+                    "eviction = backend watcher FAILED event (poll 0.2s), "
+                    "recovered = 2-process world completing tasks again "
+                    "(one relaunch adopts the warm spare, the peer's "
+                    "RESTART relaunch follows)",
+        }
+        print(json.dumps(result), flush=True)
+        out = os.environ.get(
+            "RDZV_BENCH_OUT",
+            os.path.join(_REPO_ROOT, "artifacts", "rendezvous_pod_r05.json"),
+        )
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, "w") as f:
+            json.dump(
+                {
+                    **result,
+                    "command": " ".join(sys.argv),
+                    "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                },
+                f, indent=1,
+            )
+        log(f"artifact written to {out}")
+    finally:
+        stop.set()
+        manager.stop()
+        server.stop()
+
+
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "pod":
+        main_pod()
+    else:
+        main()
