@@ -110,6 +110,78 @@ def _park_as_standby(go_file: str) -> str:
     return worker_id
 
 
+def settle_membership(
+    master,
+    worker_id: str,
+    membership: dict,
+    *,
+    stable_s: Optional[float] = None,
+    poll_s: Optional[float] = None,
+    max_s: Optional[float] = None,
+    clock=time.time,
+    sleep=time.sleep,
+) -> dict:
+    """The gang-formation wait: return the membership view to fix the
+    jax.distributed world on.
+
+    When the master publishes the fleet's DESIRED size (``expected``),
+    form the world once the full gang is registered AND every member has
+    CONFIRMED the current version (registration or the versioned
+    heartbeat this loop sends).  Both halves matter: without the size
+    gate, staggered relaunches form worlds one member at a time; without
+    the confirmation gate, a fresh relaunch forms a world with a STALE
+    incarnation that is about to restart — each late restart then
+    restarts everyone who already formed (measured 54 s of churn on a
+    2-pod peer-death recovery before these gates; docs/perf.md).  Fall
+    back to the version-stability heuristic when the master doesn't
+    publish a target (hand-spawned workers), and proceed with whoever is
+    present at the deadline either way: a crash-looping peer must degrade
+    the world, not wedge it.
+    """
+    stable_s = SETTLE_STABLE_S if stable_s is None else stable_s
+    poll_s = SETTLE_POLL_S if poll_s is None else poll_s
+    max_s = SETTLE_MAX_S if max_s is None else max_s
+    deadline = clock() + max_s
+    stable_since = clock()
+    while clock() < deadline:
+        expected = membership.get("expected") or 0
+        confirmed = membership.get("confirmed") or {}
+        version = membership["version"]
+        if (
+            expected
+            and membership["world_size"] >= expected
+            and all(
+                confirmed.get(w) == version for w in membership["workers"]
+            )
+        ):
+            break
+        sleep(poll_s)
+        try:
+            # The versioned heartbeat IS this worker's confirmation of
+            # the view it currently intends to form.
+            master.call(
+                "Heartbeat", {"worker_id": worker_id, "version": version}
+            )
+            current = master.call("GetMembership", {})
+        except Exception:
+            # Master briefly unreachable (mass relaunch is exactly when
+            # this loop runs): retry next poll rather than burning
+            # relaunch budget on a healthy worker.
+            continue
+        if current["version"] != membership["version"]:
+            stable_since = clock()
+        elif not expected and clock() - stable_since >= stable_s:
+            membership = current
+            break
+        # Adopt unconditionally: the confirmed map advances WITHOUT a
+        # version bump (peers confirm by heartbeat), so updating only on
+        # version change would freeze the formation condition at its
+        # registration-time snapshot and ride every settle to the
+        # deadline.
+        membership = current
+    return membership
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     try:
         config = JobConfig.from_env()
@@ -194,58 +266,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
 
     if config.multihost:
-        # When the master publishes the fleet's DESIRED size ("expected"),
-        # form the world once the full gang is registered AND every member
-        # has CONFIRMED the current version (registration or the versioned
-        # heartbeat this loop sends).  Both halves matter: without the
-        # size gate, staggered relaunches form worlds one member at a
-        # time; without the confirmation gate, a fresh relaunch forms a
-        # world with a STALE incarnation that is about to restart — each
-        # late restart then restarts everyone who already formed (measured
-        # 54 s / 43 s of churn on a 2-pod peer-death recovery before these
-        # gates).  Fall back to the version-stability heuristic when the
-        # master doesn't publish a target (hand-spawned workers), and
-        # proceed with whoever is present at SETTLE_MAX_S either way: a
-        # crash-looping peer must degrade the world, not wedge it.
-        deadline = time.time() + SETTLE_MAX_S
-        stable_since = time.time()
-        while time.time() < deadline:
-            expected = membership.get("expected") or 0
-            confirmed = membership.get("confirmed") or {}
-            version = membership["version"]
-            if (
-                expected
-                and membership["world_size"] >= expected
-                and all(
-                    confirmed.get(w) == version
-                    for w in membership["workers"]
-                )
-            ):
-                break
-            time.sleep(SETTLE_POLL_S)
-            try:
-                # The versioned heartbeat IS this worker's confirmation of
-                # the view it currently intends to form.
-                master.call(
-                    "Heartbeat", {"worker_id": worker_id, "version": version}
-                )
-                current = master.call("GetMembership", {})
-            except Exception:
-                # Master briefly unreachable (mass relaunch is exactly
-                # when this loop runs): retry next poll rather than
-                # burning relaunch budget on a healthy worker.
-                continue
-            if current["version"] != membership["version"]:
-                stable_since = time.time()
-            elif not expected and time.time() - stable_since >= SETTLE_STABLE_S:
-                membership = current
-                break
-            # Adopt unconditionally: the confirmed map advances WITHOUT a
-            # version bump (peers confirm by heartbeat), so updating only
-            # on version change would freeze the formation condition at
-            # its registration-time snapshot and ride every settle to the
-            # deadline.
-            membership = current
+        membership = settle_membership(master, worker_id, membership)
         spec = distributed.spec_from_membership(
             membership,
             worker_id,

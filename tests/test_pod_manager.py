@@ -429,3 +429,36 @@ def test_warm_standby_adopted_on_relaunch(tmp_path):
     # the job.
     assert backend._standby == []
     assert not os.path.isdir(standby_dir)
+
+
+def test_dead_spare_falls_back_to_cold_spawn(tmp_path):
+    """A spare that died while parked must not be adopted — the launch
+    degrades to a cold spawn (spares are latency, never correctness)."""
+    script = tmp_path / "stub.py"
+    script.write_text(STANDBY_STUB)
+    backend = ProcessPodBackend(
+        argv=[sys.executable, str(script)], warm_standby=True
+    )
+    env = {
+        "ELASTICDL_WORKER_ID": "w-0",
+        "ELASTICDL_WORKER_SLOT": "0",
+        "STANDBY_TEST_OUT": str(tmp_path),
+    }
+    try:
+        backend.start_pod("w-0", env)
+        _wait(lambda: len(backend._standby) == 1, what="spare parked")
+        backend._standby[0][0].kill()  # the spare dies while parked
+        backend._standby[0][0].wait(timeout=10)
+
+        env2 = dict(env, ELASTICDL_WORKER_ID="w-1", ELASTICDL_WORKER_SLOT="1")
+        backend.start_pod("w-1", env2)
+        _wait(lambda: (tmp_path / "ran.w-1").exists(), what="w-1 boot")
+        assert (tmp_path / "ran.w-1").read_text().startswith("cold:")
+        # And the pool healed itself with a fresh live spare.
+        _wait(
+            lambda: len(backend._standby) == 1
+            and backend._standby[0][0].poll() is None,
+            what="pool refilled",
+        )
+    finally:
+        backend.close()
