@@ -182,6 +182,53 @@ def settle_membership(
     return membership
 
 
+#: Hard-exit bound after SIGTERM: k8s preemption grants a grace window
+#: (default 30 s) before SIGKILL; the snapshot must not gamble on using
+#: all of it, and a wedged snapshot must still exit RESTART in time for
+#: the relaunch to ride the warm standby.
+PREEMPTION_EXIT_S = 15.0
+
+
+def _install_preemption_handler(worker_holder: dict) -> None:
+    """SIGTERM = preemption notice (k8s eviction, spot reclaim, pod
+    delete): snapshot if safe, then exit RESTART so the pod manager
+    relaunches without burning failure budget and gang peers re-form
+    immediately instead of discovering the death by heartbeat.
+
+    The handler only SPAWNS the graceful thread: the signal frame may be
+    inside jax/XLA calls, where re-entering jax (device_get in the save)
+    is not safe — the work happens on a plain thread while a hard timer
+    bounds the whole exit (PS main has used this SIGTERM shape since r3;
+    the worker was the gap).
+    """
+    import signal
+
+    def _graceful() -> None:
+        try:
+            w = worker_holder.get("worker")
+            if w is not None:
+                w.preemption_snapshot()
+        except Exception:
+            logger.exception("preemption snapshot failed; exiting anyway")
+        finally:
+            sys.stderr.flush()
+            sys.stdout.flush()
+            os._exit(RESTART_EXIT_CODE)
+
+    def _on_term(signum, frame):
+        logger.info("SIGTERM: preemption notice; snapshot + RESTART exit")
+        threading.Thread(
+            target=_graceful, name="preemption", daemon=True
+        ).start()
+        t = threading.Timer(
+            PREEMPTION_EXIT_S, lambda: os._exit(RESTART_EXIT_CODE)
+        )
+        t.daemon = True
+        t.start()
+
+    signal.signal(signal.SIGTERM, _on_term)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     try:
         config = JobConfig.from_env()
@@ -260,6 +307,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 logger.exception("death watch tick failed; will retry")
 
     threading.Thread(target=_beat, daemon=True, name="heartbeat").start()
+    _install_preemption_handler(worker_holder)
     logger.info(
         "worker %s registered (membership v%s, world %s)",
         worker_id, membership.get("version"), membership.get("world_size"),

@@ -146,6 +146,13 @@ class Worker:
         # Python-side step counter mirroring state.step: reading the device
         # scalar would drain the dispatch pipeline at every task boundary.
         self._steps_dispatched = 0
+        # Set by preemption_snapshot (SIGTERM thread): the task loop parks
+        # at its next boundary instead of dispatching more work, so the
+        # live state leaves the donated-in-flight window and can be saved.
+        # _parked acknowledges the park — once True, the loop only sleeps,
+        # so self.state can no longer be donated or reassigned.
+        self._preempting = False
+        self._parked = False
 
         if config.checkpoint_dir:
             self._ckpt = CheckpointManager(
@@ -415,13 +422,90 @@ class Worker:
                     {"path": self._ckpt.directory, "step": step},
                 )
         elif self._rank == 0:
-            self._ckpt.save(step, jax.device_get(self.state))
-            self.trainer.save_host_stores(self._ckpt.directory, step)
-            self._last_ckpt_step = step
-            self.master.call(
-                "ReportCheckpoint",
-                {"path": self._ckpt.directory, "step": step},
+            self._save_snapshot(step)
+
+    def _save_snapshot(self, step: int, wait: bool = False, state=None) -> None:
+        """The non-group save trio: Orbax dense state + host-store shards +
+        master report.  One definition so the periodic checkpoint and the
+        preemption snapshot cannot drift apart.  ``state`` lets the
+        preemption path save its single captured reference."""
+        state = self.state if state is None else state
+        self._ckpt.save(step, jax.device_get(state), wait=wait)
+        self.trainer.save_host_stores(self._ckpt.directory, step)
+        self._last_ckpt_step = step
+        self.master.call(
+            "ReportCheckpoint",
+            {"path": self._ckpt.directory, "step": step},
+        )
+
+    def preemption_snapshot(self) -> bool:
+        """Best-effort state save on SIGTERM (k8s preemption grace window).
+
+        Returns True when a snapshot was written.  Deliberately narrow:
+        - group mode never solo-saves (Orbax saves are COLLECTIVE in a
+          multi-process world — see ``_maybe_checkpoint`` — and the gang
+          is being preempted precisely when peers may already be gone);
+          the fleet relies on its periodic collective checkpoints, and
+          the fast RESTART exit is itself the win (peers re-form without
+          waiting out heartbeats).
+        - non-rank-0 workers never solo-save either (same shared-dir gate
+          as ``_maybe_checkpoint``: a node drain preempting several
+          workers at once must not race Orbax commits in one directory).
+        - a state still donated-in-flight after the park window is
+          skipped: the periodic checkpoint covers the resume rather than
+          risking a read of consumed buffers.
+        Runs on the preemption thread, not in the signal handler frame.
+        """
+        self._preempting = True  # parks the task loop at its next boundary
+        if (
+            self._group_mode
+            or self._rank != 0
+            or self._ckpt is None
+            or self.state is None
+        ):
+            logger.info(
+                "preemption snapshot skipped (group=%s rank=%d ckpt=%s "
+                "state=%s)",
+                self._group_mode, self._rank, self._ckpt is not None,
+                self.state is not None,
             )
+            return False
+        from elasticdl_tpu.parallel.trainer import _state_alive
+
+        # Wait for the task loop to ACKNOWLEDGE the park: once _parked is
+        # set the loop only sleeps, so self.state can no longer be donated
+        # or reassigned under us.  Under continuous dispatch the state
+        # spends most wall-clock donated into the in-flight step, so this
+        # is the common path, bounded well inside the grace window.
+        deadline = time.time() + 5.0
+        while not self._parked and time.time() < deadline:
+            time.sleep(0.05)
+        # Single capture: everything below uses this reference, so a main
+        # thread that never parked (wedged mid-dispatch) can at worst make
+        # the capture dead — checked once — not swap it mid-save.
+        state = self.state
+        if state is None or not _state_alive(state):
+            logger.info("preemption snapshot skipped (state in flight)")
+            return False
+        # The pipelined previous task's report is already reflected in
+        # this state; report it now or the master waits out the task
+        # timeout and REQUEUES work the snapshot already contains
+        # (double-applied examples on resume).
+        try:
+            self._flush_pending()
+        except Exception:
+            logger.exception("preemption flush of pending report failed")
+        step = int(state.step)  # settles the in-flight dispatch
+        try:
+            self._save_snapshot(step, wait=True, state=state)
+        except Exception:
+            # Dense may have landed while host stores/report failed; the
+            # torn-pair walk at restore refuses a dense-only step, so a
+            # partial write degrades to the previous checkpoint.
+            logger.exception("preemption snapshot incomplete")
+            return False
+        logger.info("preemption snapshot at step %d", step)
+        return True
 
     # ---- profiling ----
 
@@ -851,6 +935,13 @@ class Worker:
         self._tasks_done = 0
         self._steps_dispatched = int(self.state.step)
         while True:
+            if self._preempting:
+                # SIGTERM arrived: the preemption thread owns the exit
+                # (snapshot + os._exit); dispatching more work would keep
+                # the state donated-in-flight and unsaveable.  Park.
+                self._parked = True
+                time.sleep(self._poll)
+                continue
             self._check_membership()
             if self._group_mode:
                 # Lockstep pull: every process of the world executes the same
