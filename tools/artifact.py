@@ -1,0 +1,53 @@
+"""Shared number-of-record artifact writer for the bench tools.
+
+Every perf tool commits its measurement as a JSON file under
+``artifacts/`` stamped with the command line and UTC time (docs/perf.md
+quotes the files; VERDICT r4 Next #5).  One definition so the write idiom
+— env override, directory creation, stamping — cannot drift per tool.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Callable, Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def write_artifact(
+    result: dict,
+    default_name: str,
+    env_var: str = "",
+    path: Optional[str] = None,
+    log: Optional[Callable[[str], None]] = None,
+) -> str:
+    """Write ``result`` (+ command/utc stamp) and return the path.
+
+    Resolution order: explicit ``path`` arg, then ``env_var`` if set in the
+    environment, then ``artifacts/<default_name>`` at the repo root.  A
+    bare filename (no directory part) writes to the current directory.
+    """
+    out = (
+        path
+        or (os.environ.get(env_var, "") if env_var else "")
+        or os.path.join(_REPO_ROOT, "artifacts", default_name)
+    )
+    d = os.path.dirname(out)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(
+            {
+                **result,
+                "command": " ".join(sys.argv),
+                "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            },
+            f,
+            indent=1,
+        )
+    say = log or (lambda m: print(m, file=sys.stderr, flush=True))
+    say(f"artifact written to {out}")
+    return out

@@ -32,8 +32,12 @@ import sys
 import tempfile
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# FORCE cpu (not setdefault): the image exports JAX_PLATFORMS=axon, so a
+# default would aim this CPU-harness tool at the real (possibly hung) chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 from elasticdl_tpu.common.platform import apply_platform_env, enable_compile_cache
 
@@ -110,14 +114,22 @@ def main() -> None:
     again = resize(4, seed=12)  # seen 4-dev topology too
     print(f"[elastic-bench] warm 8->4: {again}", file=sys.stderr)
 
-    print(json.dumps({
+    result = {
         "metric": "elastic_rerendezvous_latency_s",
         "cold_8_to_4": cold,
         "warm_4_to_8": back,
         "warm_8_to_4": again,
         "value": again["total_s"],
         "unit": "seconds (membership bump -> first post-resize step done)",
-    }))
+    }
+    print(json.dumps(result))
+    from tools.artifact import write_artifact
+
+    # Number-of-record artifact (docs/perf.md quotes the file).
+    write_artifact(
+        result, "elastic_inprocess_r05.json", env_var="ELASTIC_BENCH_OUT",
+        log=lambda m: print(f"[elastic-bench] {m}", file=sys.stderr),
+    )
 
 
 if __name__ == "__main__":

@@ -26,7 +26,9 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# FORCE cpu (not setdefault): the image exports JAX_PLATFORMS=axon, so a
+# default would aim this CPU-harness tool at the real (possibly hung) chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -111,12 +113,11 @@ def main() -> None:
             "wire_bytes_per_example_pre": pre_bytes // MINIBATCH,
         },
     }
-    os.makedirs(os.path.dirname(args.out), exist_ok=True)
-    with open(args.out, "w") as f:
-        json.dump(artifact, f, indent=1)
+    from tools.artifact import write_artifact
+
+    write_artifact(artifact, "ingest_stages_r05.json", path=args.out, log=log)
     print(json.dumps({**artifact["stages"], **artifact["derived"]}),
           flush=True)
-    log(f"artifact written to {args.out}")
 
 
 if __name__ == "__main__":

@@ -33,7 +33,9 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# FORCE cpu (not setdefault): the image exports JAX_PLATFORMS=axon, so a
+# default would aim this CPU-harness tool at the real (possibly hung) chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -179,11 +181,10 @@ def main() -> None:
                 "this scale (per-worker division is meaningless under "
                 "full CPU sharing)",
     }
-    os.makedirs(os.path.dirname(args.out), exist_ok=True)
-    with open(args.out, "w") as f:
-        json.dump(artifact, f, indent=1)
+    from tools.artifact import write_artifact
+
+    write_artifact(artifact, "multiworker_r05.json", path=args.out, log=log)
     print(json.dumps(artifact["fleets"]), flush=True)
-    log(f"artifact written to {args.out}")
 
 
 if __name__ == "__main__":

@@ -36,7 +36,9 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# FORCE cpu (not setdefault): the image exports JAX_PLATFORMS=axon, so a
+# default would aim this CPU-harness tool at the real (possibly hung) chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=4")
 
 
@@ -226,23 +228,11 @@ def main() -> None:
                     "full task (2 steps)",
         }
         print(json.dumps(result), flush=True)
-        out = os.environ.get(
-            "RDZV_BENCH_OUT",
-            os.path.join(_REPO_ROOT, "artifacts", "rendezvous_r05.json"),
+        from tools.artifact import write_artifact
+
+        write_artifact(
+            result, "rendezvous_r05.json", env_var="RDZV_BENCH_OUT", log=log
         )
-        os.makedirs(os.path.dirname(out), exist_ok=True)
-        with open(out, "w") as f:
-            json.dump(
-                {
-                    **result,
-                    "command": " ".join(sys.argv),
-                    "utc": time.strftime(
-                        "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
-                    ),
-                },
-                f, indent=1,
-            )
-        log(f"artifact written to {out}")
     finally:
         stop.set()
         if standby is not None and standby[0].poll() is None:
@@ -383,21 +373,12 @@ def main_pod() -> None:
                     "RESTART relaunch follows)",
         }
         print(json.dumps(result), flush=True)
-        out = os.environ.get(
-            "RDZV_BENCH_OUT",
-            os.path.join(_REPO_ROOT, "artifacts", "rendezvous_pod_r05.json"),
+        from tools.artifact import write_artifact
+
+        write_artifact(
+            result, "rendezvous_pod_r05.json", env_var="RDZV_BENCH_OUT",
+            log=log,
         )
-        os.makedirs(os.path.dirname(out), exist_ok=True)
-        with open(out, "w") as f:
-            json.dump(
-                {
-                    **result,
-                    "command": " ".join(sys.argv),
-                    "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-                },
-                f, indent=1,
-            )
-        log(f"artifact written to {out}")
     finally:
         stop.set()
         manager.stop()
