@@ -29,7 +29,6 @@ from elasticdl_tpu.data.codecs import cifar10_feed
 from elasticdl_tpu.models.spec import ModelSpec
 
 NUM_CLASSES = 10
-IMAGE_SHAPE = (32, 32, 3)
 
 
 def _conv_init(rng, shape):
@@ -85,11 +84,21 @@ def _apply_block(params, x, stride: int):
     return jax.nn.relu(x + y)
 
 
-def _init_params(rng, stages: Tuple[int, ...], width: int) -> Dict[str, Any]:
+def _init_params(
+    rng,
+    stages: Tuple[int, ...],
+    width: int,
+    num_classes: int = NUM_CLASSES,
+    imagenet_stem: bool = False,
+) -> Dict[str, Any]:
     ks = jax.random.split(rng, 2 + len(stages))
+    # ImageNet stem: 7x7/s2 conv (+ 3x3/s2 maxpool in apply) — the standard
+    # 224x224 configuration and the honest MXU-utilization benchmark shape
+    # (32x32 CIFAR convs are too small to tile the systolic array well).
+    stem_kernel = (7, 7, 3, width) if imagenet_stem else (3, 3, 3, width)
     params: Dict[str, Any] = {
         "stem": {
-            "conv": _conv_init(ks[0], (3, 3, 3, width)),
+            "conv": _conv_init(ks[0], stem_kernel),
             "gn": {"scale": jnp.ones((width,)), "bias": jnp.zeros((width,))},
         },
         "stages": {},
@@ -106,9 +115,9 @@ def _init_params(rng, stages: Tuple[int, ...], width: int) -> Dict[str, Any]:
         params["stages"][f"stage{s}"] = stage
     params["head"] = {
         "w": jax.nn.initializers.glorot_normal()(
-            ks[-1], (in_ch, NUM_CLASSES), jnp.float32
+            ks[-1], (in_ch, num_classes), jnp.float32
         ),
-        "b": jnp.zeros((NUM_CLASSES,), jnp.float32),
+        "b": jnp.zeros((num_classes,), jnp.float32),
     }
     return params
 
@@ -119,12 +128,17 @@ def _apply(
     train: bool = False,
     stages: Tuple[int, ...] = (),
     compute_dtype=jnp.bfloat16,
+    imagenet_stem: bool = False,
     **_,
 ):
     x = batch["images"].astype(compute_dtype)
     stem = params["stem"]
-    x = _conv(x, stem["conv"].astype(compute_dtype))
+    x = _conv(x, stem["conv"].astype(compute_dtype), 2 if imagenet_stem else 1)
     x = jax.nn.relu(_group_norm(x, **stem["gn"]))
+    if imagenet_stem:
+        x = jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
+        )
     for s, n_blocks in enumerate(stages):
         for b in range(n_blocks):
             stride = 2 if (s > 0 and b == 0) else 1
@@ -157,9 +171,11 @@ def _metrics(logits, batch, mask=None):
     }
 
 
-def _example_batch(batch_size: int):
+def _example_batch(batch_size: int, image_size: int = 32):
     return {
-        "images": jnp.zeros((batch_size,) + IMAGE_SHAPE, jnp.float32),
+        "images": jnp.zeros(
+            (batch_size, image_size, image_size, 3), jnp.float32
+        ),
         "labels": jnp.zeros((batch_size,), jnp.int32),
     }
 
@@ -169,20 +185,51 @@ def model_spec(
     compute_dtype: str = "bfloat16",
     depth: int = 50,
     width: int = 64,
+    image_size: int = 32,
+    num_classes: int = NUM_CLASSES,
+    imagenet_stem: bool = False,
 ) -> ModelSpec:
-    """depth=50 -> bottleneck stages (3,4,6,3); depth=14 (tests) -> (1,1,1,1)."""
+    """depth=50 -> bottleneck stages (3,4,6,3); depth=14 (tests) -> (1,1,1,1).
+
+    ``image_size=224, num_classes=1000, imagenet_stem=True`` is the
+    standard ImageNet ResNet-50 — the configuration MFU benchmarks use
+    (tools/bench_all.py 'resnet50_imagenet'); the CIFAR default matches
+    BASELINE config #2.
+    """
     stage_map = {50: (3, 4, 6, 3), 26: (2, 2, 2, 2), 14: (1, 1, 1, 1)}
     if depth not in stage_map:
         raise ValueError(f"unsupported depth {depth}, pick from {sorted(stage_map)}")
     stages = stage_map[depth]
     dtype = jnp.dtype(compute_dtype)
+    if image_size != 32 or num_classes != NUM_CLASSES:
+        # Non-CIFAR shapes have no dataset codec in the zoo: a job feeding
+        # cifar10_feed records into this variant would silently recompile
+        # against 32x32/10-class batches and train 990 dead classes.
+        # Fail loudly; the MFU bench feeds synthetic batches directly.
+        def feed(records):
+            raise RuntimeError(
+                f"resnet image_size={image_size}/num_classes={num_classes} "
+                "has no dataset codec — this variant takes synthetic "
+                "batches (tools/bench_all.py) or a custom feed, not "
+                "cifar10 records"
+            )
+    else:
+        feed = cifar10_feed
     return ModelSpec(
         name=f"cifar10_resnet{depth}",
-        init=functools.partial(_init_params, stages=stages, width=width),
-        apply=functools.partial(_apply, stages=stages, compute_dtype=dtype),
+        init=functools.partial(
+            _init_params, stages=stages, width=width,
+            num_classes=num_classes, imagenet_stem=imagenet_stem,
+        ),
+        apply=functools.partial(
+            _apply, stages=stages, compute_dtype=dtype,
+            imagenet_stem=imagenet_stem,
+        ),
         loss=_loss,
         metrics=_metrics,
         optimizer=optax.sgd(learning_rate, momentum=0.9, nesterov=True),
-        feed=cifar10_feed,
-        example_batch=_example_batch,
+        feed=feed,
+        example_batch=functools.partial(
+            _example_batch, image_size=image_size
+        ),
     )

@@ -154,3 +154,43 @@ def test_resnet50_builds():
     shapes = jax.eval_shape(spec.init, jax.random.key(0))
     n_params = sum(int(np.prod(s.shape)) for s in jax.tree.leaves(shapes))
     assert n_params > 20_000_000  # ResNet-50 class size
+
+
+def test_resnet_imagenet_stem_variant(devices):
+    """The ImageNet-shaped configuration (224x224 input, 1000-class head,
+    7x7/s2 stem + maxpool — tools/bench_all.py 'resnet50_imagenet') trains
+    a step at a reduced size: the stride-2 stem halves the spatial dims
+    twice before the stages, and the head width follows num_classes."""
+    spec = load_model_spec(
+        "elasticdl_tpu.models",
+        "cifar10_resnet.model_spec",
+        compute_dtype="float32",
+        depth=14,
+        width=8,
+        image_size=64,
+        num_classes=7,
+        imagenet_stem=True,
+    )
+    mesh = create_mesh(devices)
+    cfg = JobConfig(distribution_strategy=DistributionStrategy.ALLREDUCE)
+    trainer = Trainer(spec, cfg, mesh)
+    state = trainer.init_state(jax.random.key(0))
+    rng = np.random.RandomState(3)
+    batch = {
+        "images": rng.rand(16, 64, 64, 3).astype(np.float32),
+        "labels": rng.randint(0, 7, (16,)).astype(np.int32),
+    }
+    logits = spec.apply(jax.device_get(state).params, batch, train=False)
+    assert logits.shape == (16, 7)
+    state, metrics = trainer.train_step(state, trainer.shard_batch(batch))
+    assert np.isfinite(float(metrics["loss"]))
+    # Full-size shapes build: 1000-class ImageNet head + 7x7 stem kernel.
+    full = load_model_spec(
+        "elasticdl_tpu.models", "cifar10_resnet.model_spec",
+        depth=50, image_size=224, num_classes=1000, imagenet_stem=True,
+    )
+    shapes = jax.eval_shape(full.init, jax.random.key(0))
+    assert shapes["stem"]["conv"].shape == (7, 7, 3, 64)
+    assert shapes["head"]["w"].shape[-1] == 1000
+    n_params = sum(int(np.prod(s.shape)) for s in jax.tree.leaves(shapes))
+    assert 24_000_000 < n_params < 27_000_000  # ImageNet ResNet-50 ~25.6M
