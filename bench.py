@@ -139,6 +139,17 @@ def _emit(
     if error:
         line["error"] = error[:400]
     print(json.dumps(line), flush=True)
+    # Belt: deposit the same line under artifacts/ so a battery or driver
+    # run leaves a committed number-of-record file even if stdout capture
+    # is lost (best-effort: the printed line is the primary channel).
+    try:
+        from tools.artifact import write_artifact
+
+        write_artifact(
+            line, "bench_r05.json", env_var="BENCH_OUT", log=lambda m: None
+        )
+    except Exception:
+        pass
 
 
 def _retry(phase: str, fn):

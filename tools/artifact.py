@@ -43,6 +43,11 @@ def write_artifact(
             {
                 **result,
                 "command": " ".join(sys.argv),
+                # Which backend the process was aimed at — so a CPU smoke
+                # run can never masquerade as an on-chip number of record.
+                "jax_platforms": os.environ.get(
+                    "JAX_PLATFORMS", "(default: axon tpu)"
+                ),
                 "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
             },
             f,

@@ -103,11 +103,22 @@ def main() -> None:
     # Hang-proof init: see bench.py (VERDICT r4 Next #1).
     probe_devices(attempts=3, timeout_s=90)
     enable_compile_cache()
-    for d in (int(s) for s in args.depths.split(",")):
-        result = bench_depth(d, args.steps, args.shards, args.batch)
-        print(json.dumps(result), flush=True)
-        print(f"  depth {d}: {result['examples_per_s']:,} ex/s "
-              f"({result['step_ms']} ms/step)", file=sys.stderr)
+    results = []
+    try:
+        for d in (int(s) for s in args.depths.split(",")):
+            result = bench_depth(d, args.steps, args.shards, args.batch)
+            results.append(result)
+            print(json.dumps(result), flush=True)
+            print(f"  depth {d}: {result['examples_per_s']:,} ex/s "
+                  f"({result['step_ms']} ms/step)", file=sys.stderr)
+    finally:
+        if results:  # a mid-sweep flake still deposits what was measured
+            from tools.artifact import write_artifact
+
+            write_artifact(
+                {"metric": "async_staleness_depth_sweep", "depths": results},
+                "async_depth_r05.json", env_var="ASYNC_DEPTH_OUT",
+            )
 
 
 if __name__ == "__main__":

@@ -205,12 +205,23 @@ def main() -> None:
     # (bench.py's hang-proofing, applied battery-wide — VERDICT r4 Next #1).
     probe_devices(attempts=3, timeout_s=90)
     enable_compile_cache()
-    for name in args.configs.split(","):
-        result = bench_config(name.strip(), args.batch, args.measure)
-        print(json.dumps(result), flush=True)
-        print(f"  {name}: {result['examples_per_sec_per_chip']:,} ex/s/chip, "
-              f"{result['step_ms']} ms/step, "
-              f"MFU {result.get('mfu_pct', '?')}%", file=sys.stderr)
+    results = []
+    try:
+        for name in args.configs.split(","):
+            result = bench_config(name.strip(), args.batch, args.measure)
+            results.append(result)
+            print(json.dumps(result), flush=True)
+            print(f"  {name}: {result['examples_per_sec_per_chip']:,} "
+                  f"ex/s/chip, {result['step_ms']} ms/step, "
+                  f"MFU {result.get('mfu_pct', '?')}%", file=sys.stderr)
+    finally:
+        if results:  # a mid-battery flake still deposits what was measured
+            from tools.artifact import write_artifact
+
+            write_artifact(
+                {"metric": "bench_all_configs", "configs": results},
+                "bench_all_r05.json", env_var="BENCH_ALL_OUT",
+            )
 
 
 if __name__ == "__main__":
