@@ -142,12 +142,15 @@ def _emit(
     # Belt: deposit the same line under artifacts/ so a battery or driver
     # run leaves a committed number-of-record file even if stdout capture
     # is lost (best-effort: the printed line is the primary channel).
+    # Partials go to their OWN file — a later outage rerun must never
+    # clobber a committed real number with value:null.
     try:
         from tools.artifact import write_artifact
 
-        write_artifact(
-            line, "bench_r05.json", env_var="BENCH_OUT", log=lambda m: None
+        name = (
+            "bench_r05.json" if value is not None else "bench_r05_partial.json"
         )
+        write_artifact(line, name, env_var="BENCH_OUT", log=lambda m: None)
     except Exception:
         pass
 

@@ -203,6 +203,14 @@ class ProcessPodBackend(PodBackend):
             )
         )
 
+    @staticmethod
+    def _reap(proc) -> None:
+        """wait() a killed process so it doesn't linger as a zombie."""
+        try:
+            proc.wait(timeout=5)
+        except Exception:  # pragma: no cover — SIGKILL'd procs reap fast
+            pass
+
     def _prune_spares_locked(self, sig) -> None:
         """Drop dead spares; kill + drop spares whose job env changed."""
         keep = []
@@ -211,6 +219,7 @@ class ProcessPodBackend(PodBackend):
                 continue
             if s != sig:
                 proc.kill()
+                self._reap(proc)
                 continue
             keep.append((proc, go_file, s))
         self._standby = keep
@@ -294,6 +303,7 @@ class ProcessPodBackend(PodBackend):
                 self._prune_spares_locked(sig)
                 if len(self._standby) >= self._pool_size:
                     proc.kill()  # lost the race; the pool is already full
+                    self._reap(proc)
                     return
                 self._standby.append((proc, go_file, sig))
             logger.info("warm standby parked (pid %d)", proc.pid)
@@ -323,15 +333,23 @@ class ProcessPodBackend(PodBackend):
                 self._watcher.start()
         self._emit(name, PodPhase.RUNNING)
 
+    #: SIGTERM->SIGKILL grace on delete: must exceed the worker's
+    #: preemption-snapshot bound (worker.main PREEMPTION_EXIT_S = 15 s) or
+    #: a scale-down would tear the snapshot it just triggered mid-write.
+    #: wait() returns the moment the pod exits, so pods without state to
+    #: save (PS shards, group members) still tear down in milliseconds.
+    TERMINATE_GRACE_S = 20.0
+
     def delete_pod(self, name: str) -> None:
         with self._lock:
             proc = self._procs.pop(name, None)
         if proc is not None and proc.poll() is None:
             proc.terminate()
             try:
-                proc.wait(timeout=5)
+                proc.wait(timeout=self.TERMINATE_GRACE_S)
             except subprocess.TimeoutExpired:
                 proc.kill()
+                proc.wait(timeout=5)
         self._emit(name, PodPhase.DELETED)
 
     def _watch(self) -> None:
@@ -375,6 +393,7 @@ class ProcessPodBackend(PodBackend):
         for proc in procs:
             if proc.poll() is None:
                 proc.kill()
+                self._reap(proc)
         if standby_dir is not None:
             import shutil
 

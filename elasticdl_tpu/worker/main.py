@@ -291,15 +291,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     def _beat() -> None:
         dw_state: dict = {"pending_since": None}
         while not hb_stop.wait(0.25 if dw_state["pending_since"] else 1.0):
+            master_version = None
             try:
-                master.call("Heartbeat", {"worker_id": worker_id})
+                resp = master.call("Heartbeat", {"worker_id": worker_id})
+                master_version = resp.get("version")
             except Exception:  # master briefly unreachable: retry next beat
                 pass
             w = worker_holder.get("worker")
             if w is None:
                 continue
             try:
-                if w.death_watch_tick(dw_state, time.time()):
+                # The Heartbeat response's version lets the tick skip its
+                # own membership RPC in the steady state.
+                if w.death_watch_tick(
+                    dw_state, time.time(), master_version=master_version
+                ):
                     sys.stderr.flush()
                     sys.stdout.flush()
                     os._exit(RESTART_EXIT_CODE)

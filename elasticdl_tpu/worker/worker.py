@@ -314,7 +314,9 @@ class Worker:
             restored = self.trainer.shard_state(jax.device_get(self.state))
         self.state = restored
 
-    def death_watch_tick(self, state: dict, now: float) -> bool:
+    def death_watch_tick(
+        self, state: dict, now: float, master_version=None
+    ) -> bool:
         """One death-push decision (called from the liveness-heartbeat
         thread, worker.main): return True when this process must force-exit
         RESTART because a gang peer DIED while the main thread is wedged in
@@ -345,6 +347,15 @@ class Worker:
         by the caller if the worker restarts in place.
         """
         if not self._group_mode or self.config.death_push_grace_s <= 0:
+            state["pending_since"] = None
+            return False
+        if (
+            master_version is not None
+            and master_version == self._membership_version
+        ):
+            # The caller's own Heartbeat response already proves nothing
+            # changed — skip the GetMembership RPC (the steady-state path,
+            # so the push costs zero extra control-plane load).
             state["pending_since"] = None
             return False
         try:
@@ -497,7 +508,14 @@ class Worker:
             logger.exception("preemption flush of pending report failed")
         step = int(state.step)  # settles the in-flight dispatch
         try:
-            self._save_snapshot(step, wait=True, state=state)
+            if self._last_ckpt_step == step:
+                # The flush above crossed the periodic-checkpoint threshold
+                # and already saved THIS step (async): saving again would
+                # collide on the step dir, and exiting now would tear the
+                # in-flight write — settle it instead.
+                self._ckpt.wait()
+            else:
+                self._save_snapshot(step, wait=True, state=state)
         except Exception:
             # Dense may have landed while host stores/report failed; the
             # torn-pair walk at restore refuses a dense-only step, so a

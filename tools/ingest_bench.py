@@ -94,8 +94,6 @@ def main() -> None:
         "metric": "ingest_stage_ms_per_batch",
         "unit": f"ms per {MINIBATCH}-record criteo batch (best of "
                 f"{REPEATS}, mean over {BATCHES} shards)",
-        "command": " ".join(sys.argv),
-        "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "stages": {
             "recordio_range_read_ms": per_batch(read_s),
             "decode_raw_ms": per_batch(dec_raw_s),

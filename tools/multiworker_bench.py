@@ -171,8 +171,6 @@ def main() -> None:
         "unit": "tasks/sec",
         "harness": f"cpu ({os.cpu_count()} core host), 1 fake device per "
                    "worker, task-bound job (1 minibatch of 16 per task)",
-        "command": " ".join(sys.argv),
-        "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "fleets": results,
         "control_plane_overhead_bound_pct": round((1 - worst) * 100, 1),
         "note": "per-step dispatch + prefetch off: every task is pure "

@@ -376,7 +376,7 @@ def main_pod() -> None:
         from tools.artifact import write_artifact
 
         write_artifact(
-            result, "rendezvous_pod_r05.json", env_var="RDZV_BENCH_OUT",
+            result, "rendezvous_pod_r05.json", env_var="RDZV_POD_BENCH_OUT",
             log=log,
         )
     finally:
