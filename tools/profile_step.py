@@ -19,6 +19,8 @@ import json
 import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 from elasticdl_tpu.common.platform import apply_platform_env, enable_compile_cache
 
 apply_platform_env()
@@ -26,7 +28,9 @@ apply_platform_env()
 import jax  # noqa: E402
 
 
-def run_profiled_steps(out_dir: str, steps: int, batch_size: int, impl: str):
+def run_profiled_steps(
+    out_dir: str, steps: int, batch_size: int, impl: str, config: str = ""
+):
     import jax.numpy as jnp
 
     from elasticdl_tpu.common.config import DistributionStrategy, JobConfig
@@ -38,6 +42,27 @@ def run_profiled_steps(out_dir: str, steps: int, batch_size: int, impl: str):
     devices = jax.devices()
     print(f"devices: {len(devices)} x {devices[0].platform}", file=sys.stderr)
 
+    if config:
+        # Profile one of bench_all's configs (e.g. resnet50_imagenet) with
+        # the same spec/strategy/synthetic batch the MFU table measures.
+        from tools.bench_all import CONFIGS, _synth_batch
+
+        cfg = CONFIGS[config]
+        spec = load_model_spec(
+            "elasticdl_tpu.models", cfg["model_def"], **cfg["params"]
+        )
+        trainer = Trainer(
+            spec, JobConfig(distribution_strategy=cfg["strategy"]),
+            create_mesh(devices),
+        )
+        bs = batch_size or cfg["batch"]
+        bs = max(bs // len(devices) * len(devices), len(devices))
+        batch = trainer.shard_batch(
+            jax.device_get(_synth_batch(config, spec, bs))
+        )
+        return _profile_loop(trainer, batch, out_dir, steps)
+
+    batch_size = batch_size or 8192
     spec = load_model_spec(
         "elasticdl_tpu.models",
         "deepfm.model_spec",
@@ -63,8 +88,13 @@ def run_profiled_steps(out_dir: str, steps: int, batch_size: int, impl: str):
         "labels": jax.random.bernoulli(k3, 0.25, (batch_size,)).astype(jnp.int32),
     })
 
-    state = trainer.init_state(jax.random.key(0))
+    return _profile_loop(trainer, batch, out_dir, steps)
+
+
+def _profile_loop(trainer, batch, out_dir: str, steps: int):
     import time
+
+    state = trainer.init_state(jax.random.key(0))
     t0 = time.perf_counter()
     state, metrics = trainer.train_step(state, batch)
     jax.block_until_ready(metrics)
@@ -139,15 +169,18 @@ def _summarize(out_dir: str, top: int):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=3)
-    ap.add_argument("--batch", type=int, default=8192)
+    ap.add_argument("--batch", type=int, default=0)
     ap.add_argument("--impl", default="")
+    ap.add_argument("--config", default="",
+                    help="profile a tools/bench_all config instead of DeepFM")
     ap.add_argument("--out", default="/tmp/deepfm_profile")
     ap.add_argument("--top", type=int, default=25)
     ap.add_argument("--parse-only", action="store_true")
     args = ap.parse_args()
     os.makedirs(args.out, exist_ok=True)
     if not args.parse_only:
-        run_profiled_steps(args.out, args.steps, args.batch, args.impl)
+        run_profiled_steps(args.out, args.steps, args.batch,
+                           args.impl, config=args.config)
     parse_op_stats(args.out, args.top)
 
 
