@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import os
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Iterable, List, Optional, Sequence
 
 import jax
@@ -142,6 +143,13 @@ class Worker:
         # metrics), fetched + reported only after the NEXT task's steps are
         # dispatched (see _dispatch_training_task for why).
         self._pending: Optional[tuple] = None
+        # Prep-ahead pipeline (fused + pipelined mode): the NEXT training
+        # task's (task, report, host-prep future).  The host half (bulk
+        # read + C++ decode + stacking) runs on a one-thread pool while the
+        # previous task's transfer streams and its metrics settle, keeping
+        # the host<->device link continuously busy (see run()).
+        self._prep_next: Optional[tuple] = None
+        self._prep_pool = None
         self._tasks_done = 0
         # Python-side step counter mirroring state.step: reading the device
         # scalar would drain the dispatch pipeline at every task boundary.
@@ -399,8 +407,10 @@ class Worker:
             # Settle the in-flight pipelined task before re-forming: a
             # multihost change raises WorkerRestartRequired out of
             # _apply_membership, and an unflushed report would leave the
-            # master waiting out the task timeout to requeue.
-            self._flush_pending()
+            # master waiting out the task timeout to requeue.  The prepped
+            # task (if any) dispatches on the OLD mesh first — its state is
+            # settled before the re-form.
+            self._drain_prep()
             membership = self.master.call("GetMembership", {})
             self._apply_membership(membership)
 
@@ -555,7 +565,33 @@ class Worker:
                 return records
         return list(self.reader.read_records(shard))
 
-    def _dispatch_training_task(self, task: Task) -> tuple:
+    def _stack_full_minibatches(self, records, mb: int, n_full: int):
+        """Feed + stack every full minibatch into ONE [T, mb, ...] host
+        batch (the fused-scan wire format); shared by the training prep and
+        the fused eval path."""
+        big = self.spec.feed(records[: n_full * mb])
+        return jax.tree.map(
+            lambda v: np.ascontiguousarray(v).reshape(
+                (n_full, mb) + v.shape[1:]
+            ),
+            dict(big),
+        )
+
+    def _prep_fused_host(self, task: Task) -> tuple:
+        """Host half of a fused training task: bulk read + C++ decode +
+        [T, mb, ...] stacking.  Touches neither ``self.state`` nor the
+        device, so the prep-ahead pipeline in ``run`` executes it on a
+        background thread (the C++ codec and numpy copies release the GIL)
+        while the previous task's wire transfer and metrics settle."""
+        records = self._read_records(task.shard)
+        mb = self.config.minibatch_size
+        n_full = len(records) // mb
+        stacked = None
+        if n_full >= 1:
+            stacked = self._stack_full_minibatches(records, mb, n_full)
+        return records, stacked, n_full
+
+    def _dispatch_training_task(self, task: Task, prep: tuple = None) -> tuple:
         """Dispatch every device step of a training task WITHOUT blocking on
         results.  Returns (per-batch device metrics, n_steps).
 
@@ -568,8 +604,14 @@ class Worker:
         - the caller defers the metrics fetch (``_finalize_training_metrics``)
           until after the NEXT task's steps are dispatched (task-level
           pipelining in ``run``).
+
+        ``prep`` is an already-computed ``_prep_fused_host`` result (the
+        prep-ahead pipeline); when None the host work runs inline here.
         """
-        records = self._read_records(task.shard)
+        if prep is not None:
+            records = prep[0]
+        else:
+            records = self._read_records(task.shard)
         mb = self.config.minibatch_size
         n_steps = (len(records) + mb - 1) // mb
         pre_shard = not self.spec.host_io
@@ -586,7 +628,8 @@ class Worker:
                 )
             return batch
 
-        n_full = len(records) // mb
+        n_full = prep[2] if prep is not None else len(records) // mb
+        stacked_host = prep[1] if prep is not None else None
         try:
             if pre_shard and self.config.fused_task_scan and n_full >= 1:
                 # Whole-task fused path: ONE feed call over every full
@@ -599,12 +642,10 @@ class Worker:
                 # task-level pipeline in ``run`` overlaps this host work
                 # with the PREVIOUS task's scan.  A ragged tail trains as
                 # one extra masked step.
-                big = self.spec.feed(records[: n_full * mb])
-                stacked = jax.tree.map(
-                    lambda v: np.ascontiguousarray(v).reshape(
-                        (n_full, mb) + v.shape[1:]
-                    ),
-                    dict(big),
+                stacked = (
+                    stacked_host
+                    if stacked_host is not None
+                    else self._stack_full_minibatches(records, mb, n_full)
                 )
                 self.state, scan_metrics = self.trainer.train_scan(
                     self.state, self.trainer.shard_stacked_batch(stacked)
@@ -786,6 +827,103 @@ class Worker:
             self._tasks_done += 1
             self._maybe_checkpoint()
 
+    # ---- prep-ahead pipeline (fused + pipelined mode) ----
+
+    def _prep_ahead_eligible(self) -> bool:
+        """Prep-ahead runs the NEXT task's host work (read+decode+stack) on
+        a background thread while the current task's wire transfer streams
+        and the previous task's metrics settle — on a remote-attached chip
+        the host<->device link is the e2e bound (~20-40 MB/s measured
+        through the tunnel), and without prep-ahead it sits idle during
+        every decode and metrics fetch.  Only in single-process pipelined
+        mode, only for the fused pre-shard path (host-tier tables need the
+        host batch on the main thread), and never in a profiling session
+        (a profiled task must be traced in isolation)."""
+        return (
+            not self._group_mode
+            and self.config.task_pipelining
+            and self.config.fused_task_scan
+            and not self.spec.host_io
+            and not self.config.profile_dir
+        )
+
+    def _submit_prep(self, task: Task):
+        if self._prep_pool is None:
+            self._prep_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="edl-prep"
+            )
+        return self._prep_pool.submit(self._prep_fused_host, task)
+
+    def _dispatch_prepped(self, prepped: tuple) -> None:
+        """Dispatch a prepped task's device work, rotate it into the
+        pending (report-deferred) slot, and settle the PREVIOUS pending
+        task.  A failure (prep or dispatch) fails THIS task's report — the
+        master requeues it — exactly as the inline dispatch path does.
+
+        NEVER raises: the caller has often just queued a NEW task into
+        ``_prep_next`` whose report dict the run loop's outer exception
+        handler would wrongly fail — a task the master would requeue while
+        this worker still holds (and later trains) it, double-training its
+        records.  Lost reports are the master's task timeout's job."""
+        task, report, fut = prepped
+        try:
+            metrics_list, n_steps = self._dispatch_training_task(
+                task, prep=fut.result()
+            )
+        except Exception:
+            logger.exception("task %d failed", task.task_id)
+            report["success"] = False
+            try:
+                self.master.call("ReportTaskResult", report)
+            except Exception:
+                logger.exception(
+                    "failure report for task %d lost (master task timeout "
+                    "will requeue it)", task.task_id,
+                )
+            return
+        self._steps_dispatched += n_steps
+        report["model_version"] = self._steps_dispatched
+        self._training_tasks_done += 1
+        prev, self._pending = self._pending, (report, metrics_list)
+        try:
+            self._flush(prev)
+        except Exception:
+            # _flush already contains metric-fetch failures; what escapes is
+            # the report RPC itself.  The settled task's work is done and
+            # this worker no longer holds it — the master's timeout requeues
+            # it if the report truly never landed.
+            logger.exception(
+                "report of previous pipelined task lost (master task "
+                "timeout will requeue it)",
+            )
+
+    def _drain_prep(self) -> None:
+        """Run the prep-ahead slot to completion (dispatch + settle both
+        deferred slots): called whenever something must observe a fully
+        settled task order — eval/predict tasks, membership changes, idle
+        polls, job end."""
+        prepped, self._prep_next = self._prep_next, None
+        if prepped is not None:
+            self._dispatch_prepped(prepped)
+        self._flush_pending()
+
+    def _abandon_prep(self) -> None:
+        """Give an undispatched prepped task back to the master (failure
+        report -> immediate requeue) — the preemption path must not start
+        new device work, and silently dropping the task would make the
+        master wait out its timeout."""
+        prepped, self._prep_next = self._prep_next, None
+        if prepped is None:
+            return
+        task, report, fut = prepped
+        fut.cancel()  # not-yet-started prep must not compete with the
+        # preemption snapshot for host I/O inside the grace window
+        report["success"] = False
+        try:
+            self.master.call("ReportTaskResult", report)
+        except Exception:
+            logger.exception("abandoning prepped task %d failed", task.task_id)
+
     def _flush_pending(self) -> None:
         pending, self._pending = self._pending, None
         self._flush(pending)
@@ -813,13 +951,7 @@ class Worker:
             # Fused eval: all full chunks in ONE decode + transfer + scan
             # (the eval twin of the fused training task); only the masked
             # tail runs as a separate step.
-            big = self.spec.feed(records[: n_full * mb])
-            stacked = jax.tree.map(
-                lambda v: np.ascontiguousarray(v).reshape(
-                    (n_full, mb) + v.shape[1:]
-                ),
-                dict(big),
-            )
+            stacked = self._stack_full_minibatches(records, mb, n_full)
             metrics = jax.device_get(
                 self.trainer.eval_scan(
                     self.state, self.trainer.shard_stacked_batch(stacked)
@@ -956,7 +1088,10 @@ class Worker:
             if self._preempting:
                 # SIGTERM arrived: the preemption thread owns the exit
                 # (snapshot + os._exit); dispatching more work would keep
-                # the state donated-in-flight and unsaveable.  Park.
+                # the state donated-in-flight and unsaveable.  Give an
+                # undispatched prepped task straight back to the master
+                # (it must not start device work now), then park.
+                self._abandon_prep()
                 self._parked = True
                 time.sleep(self._poll)
                 continue
@@ -983,12 +1118,12 @@ class Worker:
             if resp["task"] is None:
                 if resp["finished"]:
                     break
-                # No new task to overlap with: settle the pipelined one NOW —
-                # the dispatcher cannot finish (or hand out follow-up work,
-                # e.g. an eval round gated on this report's model_version)
-                # until it lands, and idling on an unreported task would
-                # eventually look like a timeout/requeue.
-                self._flush_pending()
+                # No new task to overlap with: settle the pipelined ones NOW
+                # — the dispatcher cannot finish (or hand out follow-up
+                # work, e.g. an eval round gated on this report's
+                # model_version) until they land, and idling on unreported
+                # tasks would eventually look like a timeout/requeue.
+                self._drain_prep()
                 time.sleep(self._poll)
                 continue
             task = Task.from_dict(resp["task"])
@@ -1016,6 +1151,21 @@ class Worker:
                         and self.config.task_pipelining
                     )
                     try:
+                        if pipelined and self._prep_ahead_eligible():
+                            # Prep-ahead: submit THIS task's host work to
+                            # the background thread, then dispatch + settle
+                            # the PREVIOUSLY prepped task while it decodes.
+                            # The wire transfer of task N streams while
+                            # task N+1 decodes and task N-1's metrics
+                            # settle — three tasks in flight, link busy
+                            # end to end.
+                            fut = self._submit_prep(task)
+                            prepped, self._prep_next = (
+                                self._prep_next, (task, report, fut),
+                            )
+                            if prepped is not None:
+                                self._dispatch_prepped(prepped)
+                            continue
                         if pipelined:
                             metrics_list, n_steps = (
                                 self._dispatch_training_task(task)
@@ -1042,14 +1192,15 @@ class Worker:
                     report["model_version"] = int(self.state.step)
                     self._steps_dispatched = int(self.state.step)
                 elif task.type == TASK_EVALUATION:
-                    # Settle the pipelined train task first: its report must
-                    # not interleave behind this round's eval aggregation.
-                    self._flush_pending()
+                    # Settle the pipelined train tasks first: their reports
+                    # must not interleave behind this round's eval
+                    # aggregation, and the eval scores the settled state.
+                    self._drain_prep()
                     metrics, weight = self._run_evaluation_task(task)
                     report["metrics"] = metrics
                     report["weight"] = weight
                 elif task.type == TASK_PREDICTION:
-                    self._flush_pending()
+                    self._drain_prep()
                     self._run_prediction_task(task)
                 else:
                     raise ValueError(f"unknown task type {task.type}")
@@ -1082,8 +1233,8 @@ class Worker:
                 self._tasks_done += 1
                 self._maybe_checkpoint()
 
-        # Settle the last pipelined task before the final checkpoint.
-        self._flush_pending()
+        # Settle the last pipelined tasks before the final checkpoint.
+        self._drain_prep()
         # Final checkpoint so a completed job is resumable/servable.  In
         # group mode the save is collective (see _maybe_checkpoint); all
         # processes reach this point together because the finished marker is

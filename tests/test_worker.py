@@ -293,3 +293,63 @@ def test_corrupt_recordio_fails_task_cleanly(tmp_path, devices):
     assert status["done"] == 2          # healthy shards trained
     assert status["abandoned"] == 1     # corrupt shard burned its retries
     assert result["step"] == 2          # 2 healthy tasks x 1 step each
+
+
+def test_prep_ahead_pipeline_matches_synchronous(tmp_path, devices):
+    """The prep-ahead pipeline (fused + pipelined defaults) must complete
+    the same job to the same step count as the fully synchronous path, with
+    every task reported exactly once — three tasks are in flight at peak
+    (prepped / dispatched / pending-report), and a drain point (job end)
+    must settle all of them."""
+    results = {}
+    for label, flags in (
+        ("prep_ahead", dict()),  # defaults: fused + pipelined -> prep-ahead
+        ("synchronous", dict(task_pipelining=False)),
+    ):
+        config, servicer, reader, _, spec = _mnist_job(
+            tmp_path / label, num_epochs=1, **flags
+        )
+        worker = Worker(
+            config, DirectMasterProxy(servicer), reader,
+            worker_id="w0", spec=spec, devices=devices,
+        )
+        results[label] = (worker.run(), servicer, worker)
+    for label, (result, servicer, _worker) in results.items():
+        assert result["step"] == 6, label
+        assert servicer.dispatcher.finished(), label
+        assert servicer.JobStatus({})["done"] == 3, label
+    # Prep-ahead must actually have engaged: the background pool is created
+    # lazily on the first _submit_prep, so its existence proves the path ran
+    # (tasks_done alone would pass identically on the plain pipelined path).
+    assert results["prep_ahead"][0]["tasks_done"] == 3
+    assert results["prep_ahead"][2]._prep_pool is not None
+    assert results["synchronous"][2]._prep_pool is None
+
+
+def test_prep_ahead_read_failure_fails_that_task_only(tmp_path, devices):
+    """A prep (background read/decode) failure must fail THAT task's report
+    — requeued by the master — while the job still completes, mirroring the
+    inline dispatch path's contract."""
+    config, servicer, reader, _, spec = _mnist_job(tmp_path, num_epochs=1)
+
+    class FlakyReader:
+        """First read of task-shard 1 raises; retries succeed."""
+
+        def __init__(self):
+            self.failed = False
+
+        def read_records(self, shard):
+            if shard.start == 32 and not self.failed:
+                self.failed = True
+                raise RuntimeError("injected prep-read failure")
+            return reader.read_records(shard)
+
+    worker = Worker(
+        config, DirectMasterProxy(servicer), FlakyReader(),
+        worker_id="w0", spec=spec, devices=devices,
+    )
+    result = worker.run()
+    assert result["step"] == 6  # every record still trained once
+    assert servicer.dispatcher.finished()
+    status = servicer.JobStatus({})
+    assert status["done"] == 3
