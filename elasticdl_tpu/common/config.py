@@ -111,8 +111,12 @@ class JobConfig:
     # pushes may be outstanding when a pull happens (1 = the classic
     # async-PS window).  Deeper bounds hide more host RPC latency behind
     # device steps at the cost of staler rows; tools/async_depth_bench.py
-    # measures the trade (table in docs/perf.md) and the default follows
-    # that data.
+    # measures the trade.  Three on-chip sweeps (artifacts/
+    # async_depth_r05.json carries the latest, with its link probe;
+    # chip_battery_r05*.log hold the other two): async reliably beats sync
+    # (+10-30%) but the 1-vs-2-vs-4 ranking flips run to run on the
+    # tunnel's bimodal wire — no reproducible win past the classic window,
+    # so the default stays at the least-stale depth.
     async_staleness: int = 1
     # host:port list of the PS shards, comma-separated, in shard order.  Set
     # by the master onto the worker pod env; settable by hand to point

@@ -34,8 +34,29 @@ def enable_compile_cache(path: str | None = None) -> None:
     mesh; with the cache on, a previously seen (computation, topology) pair
     loads its executable from disk instead of paying the full XLA compile
     (~20-40 s on TPU).
+
+    CPU runs skip the cache entirely: this jax build's XLA:CPU AOT
+    serialization records machine-tuning pseudo-features (+prefer-no-
+    scatter/+amx-*) that its own loader then rejects/crashes on reload —
+    observed as a hard abort when ``lower().compile()`` (cost analysis)
+    re-reads an entry the same process just wrote.  CPU compiles are fast;
+    the cache only ever paid for itself on the TPU.
     """
     import jax
+
+    # Platform sniff WITHOUT initializing a backend (bench.py calls this
+    # before its killable device probe — touching jax.default_backend()
+    # here would reintroduce the un-killable hang the probe exists for).
+    # jax_platforms is a priority list; its FIRST entry is the platform a
+    # healthy process ends up on.  An empty value (no sitecustomize, no env
+    # — not this image) keeps the cache: TPU hosts are who it pays for.
+    platforms = (
+        getattr(jax.config, "jax_platforms", None)
+        or os.environ.get("JAX_PLATFORMS")
+        or ""
+    )
+    if platforms.split(",")[0].strip().lower() == "cpu":
+        return
 
     cache_dir = (
         path

@@ -42,13 +42,19 @@ def _batches(n_batches, seed0=0, b=16):
     return out
 
 
-def _run(devices, use_async, n_batches):
+def _run(devices, use_async, n_batches, async_staleness=1):
+    """Depth pinned to 1 (not the config default, which is data-chosen and
+    may move — artifacts/async_depth_r05.json): these tests characterize
+    the CLASSIC async window and its sync equivalence."""
     import jax
 
     spec = _spec()
     trainer = Trainer(
         spec,
-        JobConfig(distribution_strategy=DistributionStrategy.PARAMETER_SERVER),
+        JobConfig(
+            distribution_strategy=DistributionStrategy.PARAMETER_SERVER,
+            async_staleness=async_staleness,
+        ),
         create_mesh(devices[:4]),
     )
     state = trainer.init_state(jax.random.key(0))

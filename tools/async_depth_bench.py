@@ -103,6 +103,12 @@ def main() -> None:
     # Hang-proof init: see bench.py (VERDICT r4 Next #1).
     probe_devices(attempts=3, timeout_s=90)
     enable_compile_cache()
+    # The sweep's verdict flips with the wire's mood (a stall-window sweep
+    # ranks sync > any async depth because the pull RTT dominates), so the
+    # artifact must carry the link quality it was measured under.
+    from tools.bench_e2e import _link_probe
+
+    link = _link_probe(log=lambda m: print(m, file=sys.stderr, flush=True))
     results = []
     try:
         for d in (int(s) for s in args.depths.split(",")):
@@ -116,7 +122,11 @@ def main() -> None:
             from tools.artifact import write_artifact
 
             write_artifact(
-                {"metric": "async_staleness_depth_sweep", "depths": results},
+                {
+                    "metric": "async_staleness_depth_sweep",
+                    "depths": results,
+                    **link,
+                },
                 "async_depth_r05.json", env_var="ASYNC_DEPTH_OUT",
             )
 

@@ -77,6 +77,24 @@ CONFIGS = {
         strategy="ParameterServer",
         batch=8192,
     ),
+    # TPU-native capability extension (SURVEY §2 parallelism table: SP/CP
+    # absent upstream): decoder-only transformer LM at a GPT-2-small shape
+    # — the matmul-dominated workload.  remat off: the MFU bench wants the
+    # no-recompute step (b=16, L=1024 activations fit HBM comfortably).
+    "transformer_lm": dict(
+        model_def="transformer_lm.model_spec",
+        params=dict(
+            vocab=32768, dim=768, n_heads=12, n_layers=12,
+            seq_len=1024, max_seq=1024, remat=False,
+        ),
+        strategy="AllReduce",
+        batch=16,
+        # Per 1024-token sequence at MAC=2, fwd+bwd (x3 fwd):
+        # dense blocks 6*N*L with N=12x12*768^2=84.9M -> 522 GFLOP;
+        # attention 12 layers x 4L^2d x3 -> 116 GFLOP;
+        # tied LM head 2LdV x3 -> 155 GFLOP  ==> ~0.79 TFLOP/example.
+        analytic_flops_per_example=0.79e12,
+    ),
 }
 
 
@@ -106,6 +124,15 @@ def _synth_batch(name: str, spec, n: int):
                 ks[0], (n, size, size, 3), jnp.float32
             ),
             "labels": jax.random.randint(ks[1], (n,), 0, classes),
+        }
+    if name == "transformer_lm":
+        p = CONFIGS[name]["params"]
+        seqs = jax.random.randint(
+            ks[0], (n, p["seq_len"] + 1), 0, p["vocab"]
+        )
+        return {
+            "tokens": seqs[:, :-1],
+            "labels": seqs[:, 1:],
         }
     if name == "wide_deep":
         return {
@@ -194,7 +221,7 @@ def bench_config(name: str, batch_override: int = 0, measure: int = MEASURE) -> 
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--configs", default="mnist,resnet50,resnet50_imagenet,wide_deep")
+    ap.add_argument("--configs", default="mnist,resnet50,resnet50_imagenet,wide_deep,transformer_lm")
     ap.add_argument("--batch", type=int, default=0, help="override global batch")
     ap.add_argument("--measure", type=int, default=MEASURE)
     args = ap.parse_args()
