@@ -47,6 +47,20 @@ def attention_reference(
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def _local_attention(q, k, v, causal: bool) -> jax.Array:
+    """Exact single-shard attention: the Pallas flash kernel on TPU (no
+    O(L^2) HBM tensors — at the MFU-bench shape the XLA path's saved
+    probability tensors alone are ~19 GB at b=32, the difference between
+    OOM and 2x the batch), the XLA oracle elsewhere (CPU tests/dryruns;
+    the kernel itself is oracle-tested in interpret mode in
+    tests/test_flash_attention.py)."""
+    from elasticdl_tpu.ops.flash_attention import flash_attention, supports
+
+    if jax.default_backend() == "tpu" and supports(q, k, v):
+        return flash_attention(q, k, v, causal)
+    return attention_reference(q, k, v, causal=causal)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -62,9 +76,13 @@ def ring_attention(
     it degrades to exact single-device attention.
     """
     if axis_name is None:
-        return attention_reference(q, k, v, causal=causal)
+        return _local_attention(q, k, v, causal)
 
     n = lax.axis_size(axis_name)
+    if n == 1:
+        # Degenerate ring (1-device mesh under shard_map): exact local
+        # attention, flash-kernelled on TPU.
+        return _local_attention(q, k, v, causal)
     my = lax.axis_index(axis_name)
     b, lq, h, d = q.shape
     lk = k.shape[1]

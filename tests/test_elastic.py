@@ -79,10 +79,10 @@ def test_worker_death_loses_no_data(tmp_path, devices):
     config, servicer, reader, spec = _deepfm_job(tmp_path, n_records=128)
 
     class DyingWorker(Worker):
-        def _dispatch_training_task(self, task):
+        def _dispatch_training_task(self, task, prep=None):
             if self.worker_id == "w-doomed" and task.task_id >= 1:
                 raise KeyboardInterrupt("preempted")  # dies mid-task
-            return super()._dispatch_training_task(task)
+            return super()._dispatch_training_task(task, prep=prep)
 
     doomed = DyingWorker(
         config, DirectMasterProxy(servicer), reader,
@@ -91,10 +91,11 @@ def test_worker_death_loses_no_data(tmp_path, devices):
     with pytest.raises(KeyboardInterrupt):
         doomed.run()
     status = servicer.JobStatus({})
-    # Two tasks in flight: the pipelined task 0 (dispatched, died before its
-    # deferred report) and task 1 (died during dispatch).  Both requeue on
-    # eviction — at-least-once semantics, nothing lost.
-    assert status["doing"] == 2
+    # Three tasks in flight at death under the prep-ahead pipeline: task 0
+    # (dispatched, died before its deferred report), task 1 (died during
+    # dispatch), task 2 (prepped on the background thread, never started).
+    # All requeue on eviction — at-least-once semantics, nothing lost.
+    assert status["doing"] == 3
 
     # Master notices the death (here: pod event / heartbeat timeout path).
     servicer.rendezvous.remove("w-doomed")
