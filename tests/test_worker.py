@@ -353,3 +353,44 @@ def test_prep_ahead_read_failure_fails_that_task_only(tmp_path, devices):
     assert servicer.dispatcher.finished()
     status = servicer.JobStatus({})
     assert status["done"] == 3
+
+
+def test_background_checkpoint_failure_rolls_back_and_retries(
+    tmp_path, devices
+):
+    """A failed background periodic save must roll the watermark back so a
+    later boundary retries — and the job itself must not fail (the save
+    runs off the task loop's critical path)."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    # checkpoint_steps=4 makes the retry OBSERVABLE only via the rollback:
+    # the step-4 save fails; with the watermark rolled back to 0 the step-6
+    # boundary fires (6-0 >= 4), without it 6-4 < 4 would never retry (the
+    # job-end final save bypasses _save_snapshot, so calls stay at 1).
+    config, servicer, reader, _, spec = _mnist_job(
+        tmp_path, num_epochs=1, checkpoint_dir=ckpt_dir, checkpoint_steps=4
+    )
+    worker = Worker(
+        config, DirectMasterProxy(servicer), reader,
+        worker_id="w0", spec=spec, devices=devices,
+    )
+    calls = {"n": 0}
+    orig = Worker._save_snapshot
+
+    def flaky(self, step, wait=False, state=None):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("injected background save failure")
+        return orig(self, step, wait=wait, state=state)
+
+    worker._save_snapshot = flaky.__get__(worker)
+    result = worker.run()
+    assert result["step"] == 6
+    assert calls["n"] >= 2, "rolled-back watermark never retried"
+    # The final checkpoint is durable and reported despite the early
+    # failure; a fresh manager can restore it.
+    assert servicer.GetCheckpoint({})["step"] == 6
+    from elasticdl_tpu.common.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(ckpt_dir)
+    assert 6 in mgr.all_steps()
+    mgr.close()
