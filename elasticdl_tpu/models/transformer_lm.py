@@ -36,8 +36,14 @@ from elasticdl_tpu.ops.embedding import ParallelContext
 
 
 def _rms_norm(x, scale, eps=1e-6):
+    # Stats and the normalize/affine arithmetic in f32, ONE downcast at the
+    # end.  The previous form multiplied the downcast value by the f32
+    # ``scale`` param LAST, silently promoting every tensor downstream of
+    # the first norm (q/k/v, MLP, residuals) to f32 — the "bfloat16
+    # compute" stream was f32 end to end (caught when the flash-attention
+    # kernel received f32 operands and blew its VMEM budget at L=8192).
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
-    return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * scale
+    return ((x * jax.lax.rsqrt(var + eps)) * scale).astype(x.dtype)
 
 
 def _init_params(

@@ -253,6 +253,9 @@ class Worker:
                 and self.state is not None
             ):
                 try:
+                    # A background periodic save may be mid-flight on the
+                    # same manager; interleaving two saves tears both.
+                    self._join_ckpt()
                     step = int(self.state.step)
                     self._ckpt.save(step, jax.device_get(self.state), wait=True)
                     # Relaunched processes restore from the LOCAL checkpoint
@@ -305,6 +308,10 @@ class Worker:
         re-shard the live state (pure in-process resize)."""
         assert self.trainer is not None
         restored = None
+        # Settle any in-flight BACKGROUND save first: latest_step() must not
+        # see a step whose host-store half is still being written (the
+        # bg thread runs the whole trio outside Orbax's own wait scope).
+        self._join_ckpt()
         if self._ckpt is not None and self._ckpt.latest_step() is not None:
             self._ckpt.wait()
             template = self.trainer.shard_state(jax.device_get(self.state))

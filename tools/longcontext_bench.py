@@ -1,0 +1,122 @@
+"""Long-context single-chip capability bench — trains the GPT-2-small-shape
+transformer at increasing sequence lengths on ONE chip and records the
+longest that fits plus its throughput.
+
+What makes the long lengths possible: per-block rematerialization plus the
+Pallas flash-attention kernel (ops/flash_attention.py).  Measured split of
+credit at L=8192 (2026-07-31): remat alone lets the XLA attention path
+squeeze b=2 through — its O(L^2) score tensors ([b,12,8192,8192] f32 =
+6.4 GB at b=2) become per-block transients — but b=4 OOMs there, while the
+flash path (attention memory O(L*D)) runs it; at L=1024 the same kernel is
+what made global batch 32 fit at all (19 GB of saved probability tensors
+gone).  Beyond one chip's HBM, ring-attention sequence parallelism
+(ops/ring_attention.py) shards L over the mesh; that path is
+CPU-mesh-tested (tests/test_ring_attention.py) since this environment has
+one physical chip.
+
+Throughput caveat: wall-clock per step on the tunneled chip includes a
+large, shape-dependent execute-turnaround overhead (the L=2048 row's wall
+exceeds its ~57 ms/step device self-time several-fold; block_until_ready
+returns before execution completes on this backend, so steps settle via
+the loss fetch).  Treat tokens_per_s as a lower bound; per-op device time
+(tools/profile_step.py --config transformer_lm) is the honest instrument.
+
+Usage: python tools/longcontext_bench.py [--lengths 2048,4096,8192]
+One JSON line per length; artifact: artifacts/longcontext_r05.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from elasticdl_tpu.common.platform import apply_platform_env, enable_compile_cache
+
+apply_platform_env()
+
+
+def bench_length(seq: int, batch: int, steps: int = 5) -> dict:
+    import jax
+    import numpy as np
+
+    from elasticdl_tpu.common.config import JobConfig
+    from elasticdl_tpu.models.spec import load_model_spec
+    from elasticdl_tpu.parallel.mesh import create_mesh
+    from elasticdl_tpu.parallel.trainer import Trainer
+
+    spec = load_model_spec(
+        "elasticdl_tpu.models", "transformer_lm.model_spec",
+        vocab=32768, dim=768, n_heads=12, n_layers=12,
+        seq_len=seq, max_seq=seq, remat=True,
+    )
+    trainer = Trainer(
+        spec, JobConfig(distribution_strategy="AllReduce"),
+        create_mesh(jax.devices()),
+    )
+    try:
+        state = trainer.init_state(jax.random.key(0))
+        seqs = jax.random.randint(jax.random.key(1), (batch, seq + 1), 0, 32768)
+        b = trainer.shard_batch({"tokens": seqs[:, :-1], "labels": seqs[:, 1:]})
+        state, m = trainer.train_step(state, b)
+        # Settle the warmup via a fetch — block_until_ready returns before
+        # execution completes on this backend (see module docstring).
+        np.asarray(jax.device_get(m["loss"]))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = trainer.train_step(state, b)
+        loss = float(np.asarray(jax.device_get(m["loss"])))  # settles all steps
+        dt = (time.perf_counter() - t0) / steps
+        return {
+            "seq_len": seq, "batch": batch, "ok": True,
+            "step_ms": round(dt * 1e3, 1),
+            "tokens_per_s": round(batch * seq / dt),
+            "loss": round(loss, 3),
+        }
+    except Exception as e:  # noqa: BLE001 — OOM is a data point here
+        msg = str(e)
+        oom = "memory" in msg.lower() or "hbm" in msg.lower()
+        return {
+            "seq_len": seq, "batch": batch, "ok": False,
+            "error": "OOM" if oom else msg[:200],
+        }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--lengths", default="2048,4096,8192")
+    # b=4 is the committed artifact's configuration AND the credit-split
+    # claim (XLA+remat fits b=2 but OOMs b=4; flash runs b=4).
+    ap.add_argument("--batch", type=int, default=4)
+    args = ap.parse_args()
+    from elasticdl_tpu.common.platform import probe_devices
+
+    probe_devices(attempts=3, timeout_s=90)
+    enable_compile_cache()
+    results = []
+    try:
+        for seq in (int(s) for s in args.lengths.split(",")):
+            r = bench_length(seq, args.batch)
+            results.append(r)
+            print(json.dumps(r), flush=True)
+    finally:
+        if results:
+            from tools.artifact import write_artifact
+
+            write_artifact(
+                {
+                    "metric": "longcontext_single_chip",
+                    "model": "transformer_lm 12L/768d/12h vocab 32768, "
+                             "remat + pallas flash attention",
+                    "lengths": results,
+                },
+                "longcontext_r05.json", env_var="LONGCONTEXT_OUT",
+            )
+
+
+if __name__ == "__main__":
+    main()
