@@ -12,6 +12,7 @@ wins over the sitecustomize default.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import subprocess
 import sys
@@ -33,30 +34,20 @@ def enable_compile_cache(path: str | None = None) -> None:
     Elastic resizes and repeat bench runs re-jit the train step for a new
     mesh; with the cache on, a previously seen (computation, topology) pair
     loads its executable from disk instead of paying the full XLA compile
-    (~20-40 s on TPU).
+    (~20-40 s on TPU; elastic relaunches on the CPU harness also lean on it
+    — disabling it there regressed the warm re-rendezvous 2.5 s -> 8 s).
 
-    CPU runs skip the cache entirely: this jax build's XLA:CPU AOT
-    serialization records machine-tuning pseudo-features (+prefer-no-
-    scatter/+amx-*) that its own loader then rejects/crashes on reload —
-    observed as a hard abort when ``lower().compile()`` (cost analysis)
-    re-reads an entry the same process just wrote.  CPU compiles are fast;
-    the cache only ever paid for itself on the TPU.
+    Known hazard, handled at the one affected call site instead of here:
+    this jax build's XLA:CPU loader can hard-abort reloading an entry via
+    the ``lower().compile()`` cost-analysis path (machine-feature
+    round-trip mismatch).  Every OTHER reload pattern is empirically fine —
+    cross-process relaunches and same-process re-jits after elastic resizes
+    have run cache-on through five rounds of the suite (incl. the 4->8->4
+    resize tests) without an abort; a blanket CPU skip was tried and
+    regressed warm re-rendezvous 2.5 s -> 8 s.  tools/bench_all.py bypasses
+    the cache around exactly the crashing call (``suspend_compile_cache``).
     """
     import jax
-
-    # Platform sniff WITHOUT initializing a backend (bench.py calls this
-    # before its killable device probe — touching jax.default_backend()
-    # here would reintroduce the un-killable hang the probe exists for).
-    # jax_platforms is a priority list; its FIRST entry is the platform a
-    # healthy process ends up on.  An empty value (no sitecustomize, no env
-    # — not this image) keeps the cache: TPU hosts are who it pays for.
-    platforms = (
-        getattr(jax.config, "jax_platforms", None)
-        or os.environ.get("JAX_PLATFORMS")
-        or ""
-    )
-    if platforms.split(",")[0].strip().lower() == "cpu":
-        return
 
     cache_dir = (
         path
@@ -67,6 +58,24 @@ def enable_compile_cache(path: str | None = None) -> None:
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     # Cache even fast compiles: elastic resizes re-trace many small steps.
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+
+@contextlib.contextmanager
+def suspend_compile_cache():
+    """Temporarily disable the persistent compilation cache.
+
+    For the one known-poisonous pattern: an XLA:CPU ``lower().compile()``
+    re-reading an AOT entry the same process just wrote hard-aborts in the
+    loader (machine-feature round-trip bug in this jax build).  Wrap such
+    compiles; everything else keeps the cache (see enable_compile_cache)."""
+    import jax
+
+    prev = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
 
 
 # The probe must honor JAX_PLATFORMS the way apply_platform_env() does —

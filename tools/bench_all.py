@@ -178,10 +178,17 @@ def bench_config(name: str, batch_override: int = 0, measure: int = MEASURE) -> 
     # batch placement: the executing call may have donated the first one.
     flops = None
     try:
+        from elasticdl_tpu.common.platform import suspend_compile_cache
+
         sharded2 = trainer.shard_batch(host_batch)
-        cost = (
-            trainer._train_step.lower(state, sharded2).compile().cost_analysis()
-        )
+        # Cache bypassed: an XLA:CPU AOT entry re-read by the process that
+        # just wrote it hard-aborts in this jax build (platform.py).
+        with suspend_compile_cache():
+            cost = (
+                trainer._train_step.lower(state, sharded2)
+                .compile()
+                .cost_analysis()
+            )
         c = cost[0] if isinstance(cost, (list, tuple)) else cost
         flops = float(c.get("flops", 0.0)) or None
         sharded = sharded2
