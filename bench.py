@@ -147,10 +147,16 @@ def _emit(
     try:
         from tools.artifact import write_artifact
 
-        name = (
-            "bench_r05.json" if value is not None else "bench_r05_partial.json"
+        full = value is not None
+        name = "bench_r05.json" if full else "bench_r05_partial.json"
+        # Partials NEVER honor the env override: with BENCH_OUT pointed at
+        # the committed headline file, an outage rerun would clobber the
+        # real number with value:null — the exact hazard the name split
+        # exists to prevent.
+        write_artifact(
+            line, name, env_var="BENCH_OUT" if full else "",
+            log=lambda m: None,
         )
-        write_artifact(line, name, env_var="BENCH_OUT", log=lambda m: None)
     except Exception:
         pass
 

@@ -269,6 +269,12 @@ class ProcessPodBackend(PodBackend):
         sig = self._env_sig(full_env)
         while True:
             with self._lock:
+                if self._stop.is_set():
+                    # close() may already have reaped the pool and removed
+                    # the scratch dir; refilling now would park a fresh
+                    # jax-loaded spare forever (the orphan self-reap only
+                    # fires on parent-PID change, and the parent lives).
+                    return
                 self._prune_spares_locked(sig)
                 if len(self._standby) >= self._pool_size:
                     return
@@ -300,9 +306,11 @@ class ProcessPodBackend(PodBackend):
                 # (scale() on the main thread racing a relaunch on the
                 # watcher thread) may have topped the pool up meanwhile —
                 # an over-full pool would orphan the extras (review r5).
+                # Same for a concurrent close(): the spare must die, not
+                # park in a scratch dir close() already removed.
                 self._prune_spares_locked(sig)
-                if len(self._standby) >= self._pool_size:
-                    proc.kill()  # lost the race; the pool is already full
+                if self._stop.is_set() or len(self._standby) >= self._pool_size:
+                    proc.kill()  # lost the race; pool full or closing
                     self._reap(proc)
                     return
                 self._standby.append((proc, go_file, sig))

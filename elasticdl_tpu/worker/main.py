@@ -149,11 +149,17 @@ def settle_membership(
         version = membership["version"]
         if (
             expected
-            and membership["world_size"] >= expected
+            and membership["world_size"] == expected
             and all(
                 confirmed.get(w) == version for w in membership["workers"]
             )
         ):
+            # EXACT size, not >=: during a scale-DOWN the doomed members
+            # stay registered (and confirmed) through their terminate
+            # grace; forming an oversized world with them guarantees an
+            # immediate re-collapse as they exit.  An overshoot that
+            # never drains falls back to the deadline path below, which
+            # proceeds with whoever is present.
             break
         sleep(poll_s)
         try:

@@ -554,9 +554,21 @@ class Worker:
         deadline = time.time() + 5.0
         while not self._parked and time.time() < deadline:
             time.sleep(0.05)
-        # Single capture: everything below uses this reference, so a main
-        # thread that never parked (wedged mid-dispatch) can at worst make
-        # the capture dead — checked once — not swap it mid-save.
+        if not self._parked:
+            # The park is REQUIRED, not best-effort: a main thread merely
+            # blocked in a master RPC (mass preemption is exactly when the
+            # master is slow) resumes its iteration after we give up —
+            # donating a state we captured as live and racing our
+            # _flush_pending on the self._pending slot (duplicate or torn
+            # report).  No snapshot then; the RESTART exit still happens
+            # and the relaunch resumes from the last periodic checkpoint.
+            logger.warning(
+                "preemption snapshot skipped (task loop never parked "
+                "within 5s — likely blocked in a master RPC)",
+            )
+            return False
+        # Single capture: the parked loop only sleeps, so this reference
+        # cannot be donated or reassigned under us.
         state = self.state
         if state is None or not _state_alive(state):
             logger.info("preemption snapshot skipped (state in flight)")
@@ -850,6 +862,12 @@ class Worker:
     #: replays the identical collective sequence on all sides.
     _TRANSIENT_COLLECTIVE_MARKERS = (
         "Gloo context initialization failed: ",
+        # Suffix-resilient twin: a jaxlib upgrade rewording what follows
+        # the phrase must not silently kill the retry path (each formerly
+        # ~1s in-place retry would become a full gang restart cycle).  The
+        # "Gloo" prefix keeps the r4 tightening — generic "context
+        # initialization failed" strings still do NOT match.
+        "Gloo context initialization failed",
     )
     _GROUP_TASK_ATTEMPTS = 3
 

@@ -264,10 +264,13 @@ def main() -> None:
                 and not args.batch
                 and args.measure == MEASURE
             )
+            # Subset/smoke runs never honor the env override either — with
+            # BENCH_ALL_OUT pointed at the full-table file, the override
+            # would reintroduce the clobber the name split prevents.
             write_artifact(
                 {"metric": "bench_all_configs", "configs": results},
                 "bench_all_r05.json" if full else "bench_all_partial.json",
-                env_var="BENCH_ALL_OUT",
+                env_var="BENCH_ALL_OUT" if full else "",
             )
 
 
