@@ -147,7 +147,7 @@ def _emit(
     try:
         from tools.artifact import write_artifact
 
-        full = value is not None
+        full = not partial
         name = "bench_r05.json" if full else "bench_r05_partial.json"
         # Partials NEVER honor the env override: with BENCH_OUT pointed at
         # the committed headline file, an outage rerun would clobber the

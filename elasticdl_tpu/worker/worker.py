@@ -861,8 +861,7 @@ class Worker:
     #: member classifies this same message the same way, so re-dispatch
     #: replays the identical collective sequence on all sides.
     _TRANSIENT_COLLECTIVE_MARKERS = (
-        "Gloo context initialization failed: ",
-        # Suffix-resilient twin: a jaxlib upgrade rewording what follows
+        # Deliberately suffixless: a jaxlib upgrade rewording what follows
         # the phrase must not silently kill the retry path (each formerly
         # ~1s in-place retry would become a full gang restart cycle).  The
         # "Gloo" prefix keeps the r4 tightening — generic "context

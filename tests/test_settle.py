@@ -138,3 +138,32 @@ def test_master_blips_are_retried():
     assert all(
         view["confirmed"][w] == view["version"] for w in view["workers"]
     )
+
+
+def test_scale_down_waits_for_doomed_members_to_drain():
+    """Scale-down window: desired size drops to 2 while the 2 doomed
+    members are still registered (terminate grace).  Forming the 4-member
+    world would guarantee an immediate re-collapse as they exit — the gate
+    requires EXACT size, so formation waits for the drain."""
+    r = RendezvousServer()
+    r.set_expected(4)
+    for w, h in (("A", "h1:1"), ("B", "h2:1"), ("C", "h3:1"), ("D", "h4:1")):
+        r.register(w, h)
+    r.set_expected(2)  # scale-down begins; C and D are being torn down
+    # Everyone still heartbeats the current version during the grace.
+    for w in "BCD":
+        r.heartbeat(w, r.membership()["version"])
+    view, elapsed, steps = _drive(
+        r, "A",
+        {
+            3: lambda: r.remove("C"),
+            5: lambda: (
+                r.remove("D"),
+                r.heartbeat("B", r.membership()["version"]),
+            ),
+        },
+    )
+    assert sorted(view["workers"]) == ["A", "B"]
+    assert view["world_size"] == 2
+    assert steps >= 5  # did NOT form the oversized 4-member world
+    assert elapsed < 10
