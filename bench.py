@@ -110,6 +110,43 @@ def _watchdog() -> None:
     os._exit(2)
 
 
+def _code_rev() -> str:
+    """Commit hash of the code producing this number (best-effort).
+
+    Stamped into every bench artifact so the best-run-wins record guard
+    can tell "a worse run of the same code" (keep the record) from "the
+    first run of NEW code" (the record must follow the code): without the
+    rev gate a genuine regression could never lower the number of record.
+    A dirty tree gets a "-dirty" suffix — uncommitted changes are NEW code
+    under the same HEAD, and two dirty runs may differ from each other
+    too, so dirty never matches anything (the guard's same_rev stays
+    False and the fresh run wins).  Untracked files count as dirt: a new
+    not-yet-added module is importable code the committed rev does not
+    describe (ignored files still don't count).
+    """
+    try:
+        import subprocess
+
+        repo = os.path.dirname(os.path.abspath(__file__))
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=repo, capture_output=True, text=True, timeout=10,
+        )
+        if out.returncode != 0:
+            return ""
+        rev = out.stdout.strip()
+        st = subprocess.run(
+            ["git", "status", "--porcelain"],
+            cwd=repo, capture_output=True, text=True, timeout=10,
+        )
+        if st.returncode != 0 or st.stdout.strip():
+            rev += "-dirty"
+        return rev
+    except Exception:
+        pass
+    return ""
+
+
 def _emit(
     value: float | None,
     *,
@@ -131,6 +168,7 @@ def _emit(
             else None
         ),
     }
+    line["code_rev"] = _code_rev()
     if extras:
         line.update(extras)
     if partial:
@@ -173,13 +211,31 @@ def _emit(
                 os.path.dirname(os.path.abspath(__file__)),
                 "artifacts", "bench_r05.json",
             )
-            prev = None
+            prev = prev_rev = None
             try:
                 with open(best) as f:
-                    prev = json.load(f).get("value")
+                    rec = json.load(f)
+                prev = rec.get("value")
+                prev_rev = rec.get("code_rev")
             except Exception:
                 pass
-            if prev is None or (value is not None and value >= prev):
+            # Best-run-wins is a SAME-REVISION guard: across runs of the
+            # same code it keeps the healthy-link number (the tunnel's wire
+            # is bimodal), but once the code changes the record must follow
+            # the code — otherwise a genuine regression can never lower the
+            # number of record.  Unknown/missing revs (old artifacts, no
+            # git) count as "different": the fresh run wins.
+            same_rev = (
+                prev_rev is not None
+                and prev_rev != ""
+                # Dirty revs never match — even each other: two runs of
+                # the same dirty HEAD can be running different code.
+                and not prev_rev.endswith("-dirty")
+                and prev_rev == line["code_rev"]
+            )
+            if prev is None or (
+                value is not None and (not same_rev or value >= prev)
+            ):
                 write_artifact(
                     line, "bench_r05.json", env_var="BENCH_OUT",
                     log=lambda m: None,

@@ -10,6 +10,7 @@ to TensorBoard when ``tensorboardX`` is importable.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import threading
@@ -69,6 +70,99 @@ def finalize_metrics(metrics: Dict) -> Dict[str, float]:
     if AUC_POS in metrics and AUC_NEG in metrics:
         out["auc"] = auc_from_histograms(metrics[AUC_POS], metrics[AUC_NEG])
     return out
+
+
+class PhaseTimers:
+    """Cumulative wall-clock per named worker task-loop phase.
+
+    The job-vs-bench throughput gap (TRAINJOB_r05 53k ex/s/chip vs BENCH_r05
+    289k) was guessed at until these timers: the worker decomposes its task
+    wall into named phases so the gap is attributable instead of folklore.
+    Phase names used by the worker loop:
+
+    - ``prep_wait``   blocked on host ingest (bulk read + decode + stack, or
+                      the prep-ahead future when pipelined)
+    - ``dispatch``    issuing device work (H2D transfer + step/scan dispatch;
+                      includes the first task's XLA compile)
+    - ``step_wait``   draining device execution at the deferred metrics fetch
+    - ``metrics``     host-side metric aggregation + the report RPC
+    - ``checkpoint``  task-loop boundary cost of periodic checkpoints
+                      (snapshot dispatch + in-flight-save joins + final save)
+    - ``control``     task-acquisition RPCs (Heartbeat/GetTask/GetGroupTask)
+    - ``checkpoint_bg``  background checkpoint write + commit-barrier time —
+                      OFF the critical path, excluded from wall sums
+
+    The snapshot rides every ReportTaskResult/ReportCheckpoint, so the
+    master's view (JobStatus ``phase_times``) and the train-job artifact get
+    the decomposition without a new RPC.  Cost per entry: two
+    ``perf_counter`` calls and a locked dict add — noise next to any phase
+    worth timing.
+
+    Thread-safe: the background checkpoint thread records under its own key
+    while the task loop records the foreground phases.
+
+    Nested phases record SELF-time: a phase entered inside another phase
+    (e.g. a membership change inside the ``control`` heartbeat draining a
+    pipelined task through its dispatch/metrics/checkpoint phases)
+    subtracts its wall from the enclosing phase, so each second of the
+    task loop lands in exactly one bucket and the decomposition stays a
+    partition of (bounded by) wall time.  The nesting stack is per-thread
+    — a background phase never subtracts from a foreground one.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._seconds: Dict[str, float] = {}
+        self._counts: Dict[str, int] = {}
+        self._local = threading.local()
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        child_wall = [0.0]
+        stack.append(child_wall)
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            elapsed = time.perf_counter() - t0
+            stack.pop()
+            if stack:
+                # Report the full wall to the enclosing phase so IT can
+                # subtract; this phase keeps only its self-time.
+                stack[-1][0] += elapsed
+            self.add(name, elapsed - child_wall[0])
+
+    def add(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self._seconds[name] = self._seconds.get(name, 0.0) + seconds
+            self._counts[name] = self._counts.get(name, 0) + 1
+
+    def snapshot(self) -> Dict[str, float]:
+        """Cumulative seconds per phase (plain floats — JSON/RPC-safe)."""
+        with self._lock:
+            return {k: round(v, 6) for k, v in self._seconds.items()}
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+
+#: Phases that consume task-loop wall-clock (everything but the background
+#: checkpoint write).  Consumers summing a decomposition against wall time
+#: must restrict to these.
+CRITICAL_PATH_PHASES = (
+    "prep_wait", "dispatch", "step_wait", "metrics", "checkpoint", "control",
+)
+
+
+def critical_path_seconds(phase_times: Dict[str, float]) -> float:
+    """Sum of the wall-consuming phases of one worker's snapshot."""
+    return float(
+        sum(v for k, v in phase_times.items() if k in CRITICAL_PATH_PHASES)
+    )
 
 
 class MetricsWriter:

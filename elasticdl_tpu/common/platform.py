@@ -19,6 +19,23 @@ import sys
 import time
 
 
+def free_port() -> int:
+    """An OS-assigned free TCP port (bind-port-0 probe) — for coordinator
+    ports in single-machine multi-process harnesses, where a fixed default
+    would collide across concurrent gangs.  Inherently racy (the port is
+    released before the caller binds it); fine for tests/benches, real
+    deployments configure the coordinator port explicitly.  Lives here
+    (not parallel.distributed) so jax-free master/bench processes can
+    allocate ports without importing jax."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
 def apply_platform_env() -> None:
     platforms = os.environ.get("JAX_PLATFORMS")
     if not platforms:

@@ -61,6 +61,11 @@ MASTER_SCHEMAS: Dict[str, MessageSchema] = {
             "metrics": _DICT,
             "weight": _NUM,
             "model_version": _INT,
+            # Cumulative task-loop wall decomposition (common/metrics.py
+            # PhaseTimers.snapshot): {phase_name: seconds}.  Rides every
+            # report so the master's JobStatus and the train-job artifact
+            # can attribute throughput to named phases without a new RPC.
+            "phase_times": _DICT,
         },
     ),
     "ReportVersion": MessageSchema(
@@ -72,11 +77,25 @@ MASTER_SCHEMAS: Dict[str, MessageSchema] = {
     ),
     "DeregisterWorker": MessageSchema(required={"worker_id": _STR}),
     "Heartbeat": MessageSchema(
-        required={"worker_id": _STR}, optional={"version": _INT}
+        required={"worker_id": _STR},
+        # phase_times: group-mode non-rank-0 members never send task
+        # reports (rank-0-gated), so their phase snapshot rides the
+        # heartbeat — without it the master's per-worker decomposition
+        # only ever held rank 0 and a straggler rank was invisible.
+        optional={"version": _INT, "phase_times": _DICT},
     ),
     "GetMembership": MessageSchema(),
     "GetCheckpoint": MessageSchema(),
-    "ReportCheckpoint": MessageSchema(required={"path": _STR, "step": _INT}),
+    "ReportCheckpoint": MessageSchema(
+        required={"path": _STR, "step": _INT},
+        # Same phase snapshot as ReportTaskResult: the final/periodic
+        # checkpoint report is the last word a worker sends, so it carries
+        # the checkpoint-wire time the task reports cannot yet include.
+        # worker_id keys the snapshot to the SAME per-worker slot the task
+        # reports fill — without it the master would hold one worker's
+        # cumulative timers under two keys and consumers would double-count.
+        optional={"phase_times": _DICT, "worker_id": _STR},
+    ),
     "JobStatus": MessageSchema(),
 }
 

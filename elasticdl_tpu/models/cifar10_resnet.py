@@ -60,6 +60,18 @@ def _group_norm(x, scale, bias, groups=8, eps=1e-5):
     Gradients flow through the folded a/b exactly as through the unfolded
     math (they are the same function of x); only the dtype of the big
     elementwise stream changes, which is the point.
+
+    Rounding caveat of the fold: a/b are computed in f32 but CAST TO x's
+    dtype before the fused multiply-add, so in bf16 both the product
+    ``x * a`` and the pre-added offset ``b - mean * a`` round to 8
+    mantissa bits — the unfolded form would subtract the mean from x at
+    higher effective precision before scaling.  When |bias| ≈ |mean * a|
+    the offset suffers bf16 cancellation ON TOP of the one-pass variance
+    cancellation noted above.  Accepted because post-norm activations are
+    O(1) (absolute rounding error ~2^-8 of a unit-scale stream, below the
+    noise the bf16 convs already inject) and the fold is what buys the
+    single-pass memory shape; models sensitive to it should run the norm
+    stream in f32, not un-fold.
     """
     b, h, w, c = x.shape
     g = min(groups, c)

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 
+from elasticdl_tpu.common.jax_compat import axis_size
 from elasticdl_tpu.data.codecs import lm_feed
 from elasticdl_tpu.models.spec import ModelSpec
 from elasticdl_tpu.ops.ring_attention import ring_attention
@@ -99,7 +100,7 @@ def _apply(
     axis = ctx.axis_name
     # Fail loud on over-long sequences: positions past max_seq would silently
     # CLAMP on the pos_emb gather (same stance as the embedding OOV contract).
-    n_shards = lax.axis_size(axis) if axis is not None else 1
+    n_shards = axis_size(axis) if axis is not None else 1
     if l * n_shards > params["pos_emb"].shape[0]:
         raise ValueError(
             f"global sequence length {l * n_shards} exceeds max_seq "

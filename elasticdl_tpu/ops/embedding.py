@@ -96,6 +96,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from elasticdl_tpu.common.jax_compat import axis_size
+
 # TPU vreg lane count: physical rows are packed to (at most) this many lanes.
 LANES = 128
 
@@ -325,7 +327,7 @@ def embedding_lookup(
     # EXPLICIT ragged request is still honored so the real op can be
     # smoke-tested on a single chip.
     if impl == IMPL_DENSE or (
-        lax.axis_size(ctx.axis_name) == 1 and impl == IMPL_RAGGED_EMULATED
+        axis_size(ctx.axis_name) == 1 and impl == IMPL_RAGGED_EMULATED
     ):
         return _dense_lookup(table, ids, ctx.axis_name, dim)
     return _ragged_lookup(
@@ -360,7 +362,7 @@ def resolve_impl(
 
 
 def _dense_lookup(local_table: jax.Array, ids: jax.Array, axis_name: str, dim: int):
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my_shard = lax.axis_index(axis_name)
     rows_local = logical_rows(local_table, dim)
 
@@ -413,7 +415,7 @@ def _ragged_collective(operand, output, in_off, send, out_off, recv, axis_name,
             out_off.astype(jnp.int32), recv.astype(jnp.int32),
             axis_name=axis_name,
         )
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     me = lax.axis_index(axis_name)
     ops = lax.all_gather(operand, axis_name)          # [n, L, ...]
     IN = lax.all_gather(in_off, axis_name)            # [n, n] sender-major
@@ -443,7 +445,7 @@ def _routing_plan(ids: jax.Array, axis_name: str, rows_local: int):
     shared via one tiny [n, n] int32 all_gather; every offset both directions
     derives from it, so forward and backward use one consistent plan.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     me = lax.axis_index(axis_name)
     # Junk ids get a clamped owner; their original value then misses that
     # owner's row range and NaN-fills (fail-loud OOV, see module docstring).
@@ -476,7 +478,7 @@ def _ragged_lookup(local_table, ids, axis_name: str, dim: int, emulate: bool):
 
 
 def _ragged_lookup_fwd(local_table, ids, axis_name: str, dim: int, emulate: bool):
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     rows_local = logical_rows(local_table, dim)
     ids_shape = ids.shape
     flat_ids = ids.reshape(-1)
@@ -516,7 +518,7 @@ def _ragged_lookup_fwd(local_table, ids, axis_name: str, dim: int, emulate: bool
 def _ragged_lookup_bwd(axis_name: str, dim: int, emulate: bool, residuals, g):
     (perm, send, in_off, out_off, recv, back_in_off, back_out_off,
      local_rows, table_shape_, ids_shape) = residuals
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     L = perm.shape[0]
     # Cotangents retrace the forward id route (requester -> owner): sort by
     # owner, ragged a2a with the SAME plan, then whole-physical-row

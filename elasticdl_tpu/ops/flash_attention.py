@@ -47,9 +47,13 @@ _MAX_L = 8192   # whole-K/V-in-VMEM bound (see module docstring)
 
 
 def _use_interpret() -> bool:
-    # CPU (tests, dryruns) runs the kernel in interpreter mode — slow but
-    # exact, keeping one code path under test everywhere.
-    return jax.default_backend() == "cpu"
+    # Any non-TPU backend (CPU tests/dryruns, GPU, METAL, …) runs the
+    # kernel in interpreter mode — slow but exact, keeping one code path
+    # under test everywhere.  Gating on "not tpu" rather than "cpu":
+    # ``supports()`` passes wherever the op is mathematically valid, and a
+    # compiled Pallas-TPU lowering on a non-TPU backend fails in Mosaic
+    # after that check has already admitted the op.
+    return jax.default_backend() != "tpu"
 
 
 def _causal_mask(qi, lk: int):

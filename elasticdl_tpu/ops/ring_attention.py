@@ -27,9 +27,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from elasticdl_tpu.common.jax_compat import axis_size
+
 
 def _rotate(x: jax.Array, axis_name: str) -> jax.Array:
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     return lax.ppermute(x, axis_name, [(j, (j + 1) % n) for j in range(n)])
 
 
@@ -78,7 +80,7 @@ def ring_attention(
     if axis_name is None:
         return _local_attention(q, k, v, causal)
 
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     if n == 1:
         # Degenerate ring (1-device mesh under shard_map): exact local
         # attention, flash-kernelled on TPU.

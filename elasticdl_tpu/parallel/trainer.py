@@ -50,10 +50,7 @@ from elasticdl_tpu.ops.embedding import (
     table_shape,
 )
 
-try:  # jax >= 0.6 exports shard_map at top level
-    shard_map = jax.shard_map  # type: ignore[attr-defined]
-except AttributeError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
+from elasticdl_tpu.common.jax_compat import axis_size, shard_map
 
 
 class TrainState(struct.PyTreeNode):
@@ -941,7 +938,7 @@ def build_train_step(
     def local_step(state: TrainState, batch):
         n = 1
         for a in axes:
-            n *= lax.axis_size(a)
+            n *= axis_size(a)
         batch = dict(batch)
         mask = batch.pop("__mask__", None) if wants_mask else None
         host_in = {k: batch.pop(k) for k in host_keys}

@@ -25,10 +25,7 @@ from elasticdl_tpu.ops.embedding import (
 )
 from elasticdl_tpu.parallel.mesh import create_mesh
 
-try:
-    shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from elasticdl_tpu.common.jax_compat import shard_map
 
 VOCAB = 64  # divisible by 8 so a [V, D] table div-shards cleanly
 DIM = 16

@@ -16,7 +16,6 @@ Three layers of evidence:
 
 import os
 import signal
-import socket
 import subprocess
 import sys
 import threading
@@ -238,11 +237,11 @@ def test_group_task_failure_forces_resync(tmp_path, devices):
 
 
 def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    # common.platform is jax-free: the shared helper without the jax
+    # import parallel.distributed would drag in.
+    from elasticdl_tpu.common.platform import free_port
+
+    return free_port()
 
 
 _incarnation = {}  # (log_dir, worker_id) -> launch count (per-test isolation)
@@ -679,3 +678,279 @@ def test_two_process_hierarchical_mesh_trains(tmp_path):
             if p.poll() is None:
                 p.kill()
         server.stop()
+
+
+# ---------------------------------------------------------------------------
+# 4. r6 gang-mode hot-path parity: prep-ahead pipelining + non-blocking
+#    group checkpoints
+# ---------------------------------------------------------------------------
+
+
+def _lockstep_pair(tmp_path, devices, reader, servicer, **cfg_kwargs):
+    """Two in-process group-mode workers over one servicer, both registered
+    up front (the test_two_workers_lockstep_in_process harness)."""
+    from elasticdl_tpu.models.spec import load_model_spec
+    from elasticdl_tpu.worker.worker import DirectMasterProxy, Worker
+
+    config = JobConfig(
+        model_def="mnist.model_spec",
+        minibatch_size=16,
+        multihost=True,
+        **cfg_kwargs,
+    )
+    spec = load_model_spec(
+        "elasticdl_tpu.models", "mnist.model_spec", compute_dtype="float32"
+    )
+    memberships = {
+        w: servicer.RegisterWorker({"worker_id": w}) for w in ("w-a", "w-b")
+    }
+    memberships["w-a"] = memberships["w-b"]  # both hold the final view
+    workers = {
+        w: Worker(
+            config, DirectMasterProxy(servicer), reader,
+            worker_id=w, spec=spec, devices=devices,
+        )
+        for w in ("w-a", "w-b")
+    }
+    return workers, memberships
+
+
+def _run_pair(workers, memberships):
+    results, errors = {}, {}
+
+    def run(w):
+        try:
+            results[w] = workers[w].run(membership=memberships[w])
+        except Exception as e:  # pragma: no cover - surfaced by asserts
+            errors[w] = e
+
+    threads = [threading.Thread(target=run, args=(w,)) for w in workers]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    return results
+
+
+def test_group_prep_ahead_pipelined_lockstep(tmp_path, devices):
+    """r6 tentpole: with the ``not _group_mode`` gate lifted, lockstep
+    workers run the prep-ahead pipeline — every task's host decode/stack
+    happens on the background prep thread, while its DISPATCH stays inside
+    the lockstep boundary (both members dispatch the identical task order,
+    every dispatch carrying a prepped payload)."""
+    path, reader, shards = _shards(tmp_path)
+    servicer = MasterServicer(TaskDispatcher(shards))
+    workers, memberships = _lockstep_pair(
+        tmp_path, devices, reader, servicer,
+        training_data=path, fused_task_scan=True, task_pipelining=True,
+    )
+
+    prep_threads = {w: [] for w in workers}
+    dispatch_order = {w: [] for w in workers}
+    for w, worker in workers.items():
+        orig_prep = worker._prep_fused_host
+        orig_dispatch = worker._dispatch_training_task
+
+        def spy_prep(task, _w=w, _orig=orig_prep):
+            prep_threads[_w].append(threading.current_thread().name)
+            return _orig(task)
+
+        def spy_dispatch(task, prep=None, _w=w, _orig=orig_dispatch):
+            dispatch_order[_w].append((task.task_id, prep is not None))
+            return _orig(task, prep=prep)
+
+        worker._prep_fused_host = spy_prep
+        worker._dispatch_training_task = spy_dispatch
+
+    results = _run_pair(workers, memberships)
+    assert results["w-a"]["tasks_done"] == results["w-b"]["tasks_done"] == 4
+    assert servicer.dispatcher.counts()["done"] == 4  # exactly one report
+    assert servicer.dispatcher.finished()
+    for w, worker in workers.items():
+        assert worker._group_mode, w
+        # the gate is gone: pipelining reports enabled in group mode
+        assert worker._pipelining_enabled(), w
+        # prep ran, and ran on the background prep thread
+        assert len(prep_threads[w]) == 4, (w, prep_threads)
+        assert all(n.startswith("edl-prep") for n in prep_threads[w]), (
+            w, prep_threads,
+        )
+        # every dispatch consumed a prepped payload
+        assert all(had_prep for _, had_prep in dispatch_order[w]), (
+            w, dispatch_order,
+        )
+    # lockstep boundary: both members dispatched the identical task order
+    assert dispatch_order["w-a"] == dispatch_order["w-b"]
+    # EVERY rank's phase snapshot reaches the master: rank 0's rides its
+    # reports, the other rank's rides the heartbeat (reports are
+    # rank-0-gated) — a straggler rank must be visible per-worker
+    status = servicer.JobStatus({})
+    assert set(status["phase_times"]) == {"w-a", "w-b"}
+    for w in ("w-a", "w-b"):
+        assert status["phase_times"][w].get("dispatch", 0) > 0.0, w
+
+
+def test_group_prep_drained_on_preemption(tmp_path, devices):
+    """A group worker parking for preemption must hand its undispatched
+    prepped task back to the master (failure report -> requeue), not hold
+    it across the restart — and it must acknowledge the park BEFORE paying
+    the abandon RPC (a slow master must not consume the snapshot window)."""
+    from elasticdl_tpu.models.spec import load_model_spec
+    from elasticdl_tpu.worker.worker import (
+        DirectMasterProxy,
+        Worker,
+        WorkerRestartRequired,
+    )
+
+    path, reader, shards = _shards(tmp_path)
+    dispatcher = TaskDispatcher(shards)
+    servicer = MasterServicer(dispatcher)
+    config = JobConfig(
+        model_def="mnist.model_spec", training_data=path, minibatch_size=16,
+        multihost=True, fused_task_scan=True, task_pipelining=True,
+    )
+    spec = load_model_spec(
+        "elasticdl_tpu.models", "mnist.model_spec", compute_dtype="float32"
+    )
+    # Gang of two, but only w-b's loop runs — w-a is a confirmed phantom
+    # peer (the lockstep log issues tasks once every member confirmed), so
+    # the test observes the abandon without paying a full-job drain.
+    servicer.RegisterWorker({"worker_id": "w-a"})
+    membership = servicer.RegisterWorker({"worker_id": "w-b"})
+    servicer.Heartbeat({"worker_id": "w-a", "version": membership["version"]})
+    target = Worker(
+        config, DirectMasterProxy(servicer), reader,
+        worker_id="w-b", spec=spec, devices=devices,
+    )
+    seen = {"parked_at_abandon": None, "abandoned_task": None}
+    orig_call = target.master.call
+
+    def spy_call(method, payload=None, **kw):
+        if (
+            method == "ReportTaskResult"
+            and payload is not None
+            and not payload.get("success", True)
+            and seen["abandoned_task"] is None
+        ):
+            seen["parked_at_abandon"] = target._parked
+            seen["abandoned_task"] = payload["task_id"]
+        resp = orig_call(method, payload, **kw)
+        # Preempt as soon as a prepped-but-undispatched task exists: the
+        # NEXT loop iteration must park and abandon it.
+        if target._prep_next is not None and not target._preempting:
+            target._preempting = True
+        return resp
+
+    target.master.call = spy_call
+    errors = {}
+
+    def run_target():
+        try:
+            target.run(membership=membership)
+        except Exception as e:
+            errors["w-b"] = e
+
+    t_b = threading.Thread(target=run_target)
+    t_b.start()
+    deadline = time.time() + 90
+    while time.time() < deadline and seen["abandoned_task"] is None:
+        time.sleep(0.05)
+    assert seen["abandoned_task"] is not None, "prep never abandoned"
+    # the park was acknowledged BEFORE the (potentially slow) abandon RPC
+    assert seen["parked_at_abandon"] is True
+    # the abandoned task went straight back to the todo queue
+    assert dispatcher.counts()["todo"] >= 1
+    # end the run without draining the job: un-park, then bump the
+    # membership — the next membership check restarts the worker
+    servicer.RegisterWorker({"worker_id": "w-c"})
+    target._preempting = False
+    t_b.join(timeout=60)
+    assert isinstance(errors.get("w-b"), WorkerRestartRequired), errors
+
+
+def test_group_checkpoint_nonblocking(tmp_path, devices):
+    """r6 tentpole: the group-mode periodic checkpoint pays only the
+    device-side snapshot at the lockstep boundary — the shard write runs on
+    the background checkpoint thread on EVERY rank, completes durably, and
+    the job-end final save settles any in-flight background save first."""
+    path, reader, shards = _shards(tmp_path, n_records=128)
+    servicer = MasterServicer(TaskDispatcher(shards))
+    # Per-worker checkpoint dirs: the in-process harness emulates two
+    # processes, and two CheckpointManagers racing one directory would test
+    # the filesystem, not the worker.
+    workers, memberships = _lockstep_pair(
+        tmp_path, devices, reader, servicer,
+        training_data=path, checkpoint_steps=2,
+    )
+    from elasticdl_tpu.common.checkpoint import CheckpointManager
+
+    save_threads = {w: [] for w in workers}
+    for w, worker in workers.items():
+        worker._ckpt = CheckpointManager(str(tmp_path / f"ckpt_{w}"))
+        orig_save = worker._ckpt.save
+
+        def spy_save(step, state, wait=False, _w=w, _orig=orig_save):
+            save_threads[_w].append(
+                (threading.current_thread().name, int(step))
+            )
+            return _orig(step, state, wait=wait)
+
+        worker._ckpt.save = spy_save
+
+    results = _run_pair(workers, memberships)
+    assert results["w-a"]["tasks_done"] == results["w-b"]["tasks_done"] == 8
+    # the boundary cost and the background write are split in the phase
+    # decomposition: checkpoint (snapshot + joins) on the critical path,
+    # checkpoint_bg (write + commit) off it
+    for w in workers:
+        assert results[w]["phase_times"].get("checkpoint", 0) > 0.0, w
+        assert results[w]["phase_times"].get("checkpoint_bg", 0) > 0.0, w
+    for w, worker in workers.items():
+        names = [n for n, _ in save_threads[w]]
+        assert names, (w, save_threads)
+        # every periodic save ran OFF the task loop, on the background
+        # checkpoint thread — every rank participates (collective saves)
+        assert any(n.startswith("edl-ckpt") for n in names), (w, names)
+        # the job-end final save runs ON the worker thread, after joining
+        # the in-flight background save
+        assert not names[-1].startswith("edl-ckpt"), (w, names)
+        # background saves completed durably
+        steps_on_disk = worker._ckpt.all_steps()
+        assert len(steps_on_disk) >= 2, (w, steps_on_disk)
+        worker._ckpt.close()
+
+
+def test_group_inflight_save_settles_before_preemption_exit(tmp_path, devices):
+    """A group worker's preemption path never solo-saves, but it must JOIN
+    an in-flight background collective save before the process exit can
+    tear it (bounded by the grace window)."""
+    from elasticdl_tpu.models.spec import load_model_spec
+    from elasticdl_tpu.worker.worker import DirectMasterProxy, Worker
+
+    path, reader, shards = _shards(tmp_path)
+    servicer = MasterServicer(TaskDispatcher(shards))
+    config = JobConfig(
+        model_def="mnist.model_spec", training_data=path, minibatch_size=16,
+    )
+    spec = load_model_spec(
+        "elasticdl_tpu.models", "mnist.model_spec", compute_dtype="float32"
+    )
+    worker = Worker(
+        config, DirectMasterProxy(servicer), reader,
+        worker_id="w-a", spec=spec, devices=devices,
+    )
+    worker._group_mode = True  # the preemption path's group branch
+    done = {"t": None}
+
+    def slow_save():
+        time.sleep(0.5)
+        done["t"] = time.monotonic()
+
+    t = threading.Thread(target=slow_save, name="edl-ckpt")
+    worker._ckpt_thread = t
+    t.start()
+    assert worker.preemption_snapshot() is False  # group mode never solo-saves
+    t_return = time.monotonic()
+    assert done["t"] is not None, "preemption exit did not join the save"
+    assert t_return >= done["t"]

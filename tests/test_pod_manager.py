@@ -364,8 +364,12 @@ else:
     wid = os.environ["ELASTICDL_WORKER_ID"]
     mode = "cold"
 slot = os.environ.get("ELASTICDL_WORKER_SLOT", "?")
-with open(os.path.join(out, f"ran.{wid}"), "w") as f:
+# Atomic marker: the test polls for this file's EXISTENCE, so a plain
+# open-then-write can be observed empty on a starved box.
+marker = os.path.join(out, f"ran.{wid}")
+with open(marker + ".tmp", "w") as f:
     f.write(f"{mode}:{os.getpid()}:{slot}")
+os.replace(marker + ".tmp", marker)
 time.sleep(60)  # stay 'running' like a real worker
 """
 

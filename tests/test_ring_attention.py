@@ -11,10 +11,7 @@ from jax.sharding import PartitionSpec as P
 from elasticdl_tpu.ops.ring_attention import attention_reference, ring_attention
 from elasticdl_tpu.parallel.mesh import create_mesh
 
-try:
-    shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from elasticdl_tpu.common.jax_compat import shard_map
 
 B, L, H, D = 2, 64, 4, 16
 

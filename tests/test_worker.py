@@ -394,3 +394,95 @@ def test_background_checkpoint_failure_rolls_back_and_retries(
     mgr = CheckpointManager(ckpt_dir)
     assert 6 in mgr.all_steps()
     mgr.close()
+
+
+def test_phase_time_decomposition(tmp_path, devices):
+    """r6: the worker decomposes its task-loop wall into named phases
+    (prep_wait/dispatch/step_wait/metrics/checkpoint/control), the snapshot
+    rides its reports, and the master republishes it via JobStatus — the
+    instrument that turns the job-vs-bench throughput gap from a guess into
+    named phases."""
+    import time as _time
+
+    from elasticdl_tpu.common.metrics import (
+        CRITICAL_PATH_PHASES,
+        critical_path_seconds,
+    )
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    config, servicer, reader, _, spec = _mnist_job(
+        tmp_path, num_epochs=1, checkpoint_dir=ckpt_dir, checkpoint_steps=2
+    )
+    worker = Worker(
+        config, DirectMasterProxy(servicer), reader,
+        worker_id="w0", spec=spec, devices=devices,
+    )
+    t0 = _time.perf_counter()
+    result = worker.run()
+    wall = _time.perf_counter() - t0
+    phases = result["phase_times"]
+    # the task loop's wall-consuming phases are all present...
+    for name in ("prep_wait", "dispatch", "step_wait", "metrics",
+                 "checkpoint", "control"):
+        assert name in phases, (name, phases)
+        assert phases[name] >= 0.0
+    assert set(phases) - set(CRITICAL_PATH_PHASES) <= {"checkpoint_bg"}
+    # ...and their sum is a decomposition of (bounded by) the run's wall
+    crit = critical_path_seconds(phases)
+    assert 0.0 < crit <= wall, (crit, wall)
+    # the master's JobStatus republishes the same snapshot per worker
+    status = servicer.JobStatus({})
+    assert "w0" in status["phase_times"]
+    assert critical_path_seconds(status["phase_times"]["w0"]) > 0.0
+
+
+def test_phase_timers_nested_self_time():
+    """A phase entered inside another (e.g. a membership change inside the
+    ``control`` heartbeat draining a pipelined task through its
+    dispatch/metrics phases) records SELF-time: each second lands in
+    exactly one bucket, so the decomposition stays bounded by wall — the
+    r6 instrument must not over-attribute whole task durations to the
+    control plane."""
+    import threading
+    import time as _time
+
+    from elasticdl_tpu.common.metrics import (
+        PhaseTimers,
+        critical_path_seconds,
+    )
+
+    pt = PhaseTimers()
+    t0 = _time.perf_counter()
+    with pt.phase("control"):
+        _time.sleep(0.02)
+        with pt.phase("dispatch"):
+            _time.sleep(0.05)
+            with pt.phase("metrics"):
+                _time.sleep(0.02)
+        _time.sleep(0.01)
+    wall = _time.perf_counter() - t0
+    snap = pt.snapshot()
+    # each phase saw at least its own sleeps (no strict upper bounds:
+    # sleeps overshoot freely on a starved box, and the overshoot lands
+    # in whichever phase was open)...
+    assert snap["metrics"] >= 0.02 - 1e-4, snap
+    assert snap["dispatch"] >= 0.05 - 1e-4, snap
+    assert snap["control"] >= 0.03 - 1e-4, snap
+    # ...and the load-independent discriminator: the sum stays bounded by
+    # the outer wall.  Double-counting nested wall (the bug this guards
+    # against) would make the sum ~2x the sleeps and exceed it.
+    assert critical_path_seconds(snap) <= wall, (snap, wall)
+
+    # the nesting stack is per-thread: a background phase must not
+    # subtract from a concurrently open foreground phase
+    def bg():
+        with pt.phase("checkpoint_bg"):
+            _time.sleep(0.03)
+
+    with pt.phase("checkpoint"):
+        t = threading.Thread(target=bg)
+        t.start()
+        t.join()
+    snap = pt.snapshot()
+    assert snap["checkpoint"] >= 0.03 - 1e-4, snap
+    assert snap["checkpoint_bg"] >= 0.03 - 1e-4, snap

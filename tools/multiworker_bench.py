@@ -15,10 +15,25 @@ If the master's hot loop (SURVEY §3.2) serializes, efficiency collapses as
 workers are added; numbers near 1.0 bound the per-worker overhead at
 (1/rate) per task.
 
-Writes ONE JSON artifact (the number of record — docs/perf.md quotes the
-file): ``artifacts/multiworker_r05.json`` by default.
+Two modes:
 
-Usage: python tools/multiworker_bench.py [--fleets 1,2,4] [--tasks 96]
+- ``--mode control`` (default): the r5 task-bound job above — the per-task
+  RPC overhead bound.
+- ``--mode ingest`` (r6): gang-mode INGEST e2e.  A lockstep gang of real
+  worker processes (``multihost=True``, one jax.distributed world) trains
+  criteo recordio through the full worker path — bulk C++ read, criteo
+  decode, prefetch, fused scan, prep-ahead pipelining (group-eligible
+  since r6) — and the number is examples/sec through the gang, with the
+  workers' phase decomposition (common/metrics.py PhaseTimers) attached.
+  The control mode deliberately starves the data path; this mode is the
+  one that can see gang-mode ingest regressions at all.
+
+Writes ONE JSON artifact per mode (the number of record — docs/perf.md
+quotes the file): ``artifacts/multiworker_r05.json`` /
+``artifacts/gang_ingest_r06.json`` by default.
+
+Usage: python tools/multiworker_bench.py [--mode control|ingest]
+           [--fleets 1,2,4] [--tasks 96] [--platform cpu|chip]
 """
 
 from __future__ import annotations
@@ -35,6 +50,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # FORCE cpu (not setdefault): the image exports JAX_PLATFORMS=axon, so a
 # default would aim this CPU-harness tool at the real (possibly hung) chip.
+# The pre-force value is kept so ``--platform chip`` can hand the REAL
+# backend to worker subprocesses (the bench process itself never needs it:
+# the master is jax-free).
+_CHIP_JAX_PLATFORMS = os.environ.get("JAX_PLATFORMS", "")
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
 
@@ -143,21 +162,256 @@ def _run_fleet(n_workers: int, n_tasks: int, tmp: str, log) -> dict:
     return out
 
 
+# ---------------------------------------------------------------------------
+# ingest mode: gang-mode ingest e2e (r6)
+# ---------------------------------------------------------------------------
+
+_INGEST_MB = 2048
+_INGEST_MB_PER_TASK = 4
+_INGEST_RECORDS_PER_TASK = _INGEST_MB * _INGEST_MB_PER_TASK
+
+
+def _run_ingest_fleet(
+    n_workers: int, n_tasks: int, tmp: str, log, platform: str
+) -> dict:
+    """One lockstep gang of ``n_workers`` REAL worker processes training
+    criteo recordio end to end; returns examples/sec through the gang plus
+    the workers' phase decomposition."""
+    from elasticdl_tpu.common.config import JobConfig
+    from elasticdl_tpu.data.reader import create_data_reader
+    from elasticdl_tpu.data.synthetic import synthetic_criteo
+    from elasticdl_tpu.master.rendezvous import RendezvousServer
+    from elasticdl_tpu.common.platform import free_port
+    from elasticdl_tpu.master.servicer import MasterServer, MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.worker.worker import RESTART_EXIT_CODE
+
+    path = os.path.join(tmp, "gang_criteo.rio")
+    file_tasks = 4
+    if not os.path.exists(path):
+        synthetic_criteo(
+            path, _INGEST_RECORDS_PER_TASK * file_tasks, seed=11,
+            container="recordio",
+        )
+    reader = create_data_reader(path)
+    shards = reader.create_shards(_INGEST_RECORDS_PER_TASK)
+    epochs = -(-n_tasks // file_tasks)  # ceil
+
+    dispatcher = TaskDispatcher(shards, num_epochs=epochs)
+    rendezvous = RendezvousServer(heartbeat_timeout_s=60.0)
+    # Symmetric gang formation: settle only once every member of the full
+    # fleet has registered — an incumbent/joiner split would spend the
+    # measurement window on membership restarts instead of ingest.
+    rendezvous.set_expected(n_workers)
+    servicer = MasterServicer(dispatcher, rendezvous=rendezvous)
+    server = MasterServer(servicer, port=0).start()
+
+    # The gang-ingest parity config: the full r6 hot path — fused scan,
+    # task pipelining, prep-ahead (all group-eligible now) — on a modest
+    # CPU-compilable DeepFM.  AllReduce: dense device tables, no host tier,
+    # so prep-ahead stays eligible (host_io pins prep to the main thread).
+    config = JobConfig(
+        model_def="deepfm.model_spec",
+        model_params="buckets_per_feature=4096;embedding_dim=4;"
+                     "hidden=[64,64];compute_dtype=float32",
+        distribution_strategy="AllReduce",
+        training_data=path,
+        minibatch_size=_INGEST_MB,
+        num_minibatches_per_task=_INGEST_MB_PER_TASK,
+        num_epochs=epochs,
+        master_addr=server.address,
+        multihost=n_workers > 1,
+        coordinator_port=free_port(),
+        fused_task_scan=True,
+        task_pipelining=True,
+        checkpoint_steps=0,  # checkpoint wire has its own instrument
+        distributed_heartbeat_timeout_s=100.0,
+    )
+    env_base = dict(os.environ)
+    env_base.update(config.to_env())
+    if platform == "chip":
+        if _CHIP_JAX_PLATFORMS:
+            env_base["JAX_PLATFORMS"] = _CHIP_JAX_PLATFORMS
+        else:
+            env_base.pop("JAX_PLATFORMS", None)
+        env_base.pop("XLA_FLAGS", None)
+    else:
+        env_base["JAX_PLATFORMS"] = "cpu"
+        env_base["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        env_base.pop("PALLAS_AXON_POOL_IPS", None)
+    env_base["JAX_COMPILATION_CACHE_DIR"] = os.path.join(tmp, "jax_cache")
+
+    def _spawn(i: int):
+        env = dict(env_base)
+        env["ELASTICDL_WORKER_ID"] = f"gi-{n_workers}-{i}"
+        lf = open(os.path.join(tmp, f"gi{n_workers}_{i}.log"), "a")
+        p = subprocess.Popen(
+            [sys.executable, "-m", "elasticdl_tpu.worker.main"],
+            env=env, stdout=lf, stderr=subprocess.STDOUT, cwd=_REPO_ROOT,
+        )
+        lf.close()
+        return p
+
+    procs = {i: _spawn(i) for i in range(n_workers)}
+    fail_budget = {i: 3 for i in range(n_workers)}
+    t0 = time.perf_counter()
+    first_done = None
+    phase_times: dict = {}
+    deadline = time.time() + 1200
+    finished = False
+    try:
+        while time.time() < deadline:
+            status = servicer.JobStatus({})
+            if first_done is None and status["done"] > 0:
+                first_done = (time.perf_counter(), status["done"])
+            if status.get("phase_times"):
+                phase_times = status["phase_times"]
+            if status["finished"]:
+                finished = True
+                break
+            for i, p in list(procs.items()):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                if rc == RESTART_EXIT_CODE:
+                    # Membership churn (a peer registering mid-boot): the
+                    # gang contract IS restart-to-resync; relaunch
+                    # budget-free, exactly as the PodManager does.
+                    procs[i] = _spawn(i)
+                    continue
+                # Any other exit mirrors the PodManager's FAILED policy:
+                # relaunch while the slot's budget lasts.  The expected
+                # shape here is the coordination-runtime SIGABRT a survivor
+                # takes when the gang LEADER restarts mid-formation (its
+                # PJRT client hard-exits on the closed coordinator socket)
+                # — churn the production pod flow absorbs, not a bench
+                # failure.
+                fail_budget[i] -= 1
+                tail = ""
+                lp = os.path.join(tmp, f"gi{n_workers}_{i}.log")
+                if os.path.exists(lp):
+                    tail = open(lp).read()[-2000:]
+                if fail_budget[i] < 0:
+                    raise RuntimeError(
+                        f"gang worker {i} exited rc={rc} with relaunch "
+                        f"budget exhausted; log tail:\n{tail}"
+                    )
+                log(
+                    f"gang worker {i} exited rc={rc} "
+                    f"(budget {fail_budget[i]} left); relaunching"
+                )
+                procs[i] = _spawn(i)
+            time.sleep(0.1)
+        t_end = time.perf_counter()
+        status = servicer.JobStatus({})
+    finally:
+        # Runs on the raise paths too: surviving gang members (wedged on a
+        # dead peer) and the master server must not outlive the fleet run.
+        for p in procs.values():
+            if finished:
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+            elif p.poll() is None:
+                p.kill()
+        server.stop()
+    if not finished:
+        raise RuntimeError(
+            f"gang fleet {n_workers}: job not finished "
+            f"({status['done']} tasks done)"
+        )
+    if first_done is not None:
+        t_first, done_at_first = first_done
+    else:
+        t_first, done_at_first = t0, 0
+    measured_tasks = status["done"] - done_at_first
+    elapsed = t_end - t_first
+    if measured_tasks <= 0 or elapsed <= 0:
+        measured_tasks, elapsed = status["done"], t_end - t0
+    eps = measured_tasks * _INGEST_RECORDS_PER_TASK / elapsed
+    out = {
+        "workers": n_workers,
+        "group_mode": n_workers > 1,
+        "tasks_total": status["done"],
+        "tasks_measured": measured_tasks,
+        "records_per_task": _INGEST_RECORDS_PER_TASK,
+        "elapsed_s": round(elapsed, 3),
+        "examples_per_sec": round(eps),
+        "wall_total_s": round(t_end - t0, 2),
+        # Cumulative per-worker phase split (prep_wait/dispatch/step_wait/
+        # metrics/checkpoint/control) — the ingest number's decomposition.
+        "phase_times": phase_times,
+    }
+    log(f"ingest fleet {n_workers}: {out}")
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--fleets", default="1,2,4")
-    ap.add_argument("--tasks", type=int, default=96)
+    ap.add_argument("--mode", choices=("control", "ingest"), default="control")
+    ap.add_argument("--fleets", default="")
+    ap.add_argument("--tasks", type=int, default=0)
     ap.add_argument(
-        "--out", default=os.path.join(_REPO_ROOT, "artifacts",
-                                      "multiworker_r05.json")
+        "--platform", choices=("cpu", "chip"), default="cpu",
+        help="ingest mode: backend handed to worker subprocesses — cpu "
+             "(emulated mesh, the harness default) or chip (the image's "
+             "real accelerator env, unchanged)",
     )
+    ap.add_argument("--out", default="")
     args = ap.parse_args()
     import tempfile
 
     log = lambda m: print(f"[mw] {m}", file=sys.stderr, flush=True)
     tmp = tempfile.mkdtemp(prefix="mw_bench_")
-    fleets = [int(x) for x in args.fleets.split(",")]
-    results = [_run_fleet(n, args.tasks, tmp, log) for n in fleets]
+
+    if args.mode == "ingest":
+        fleets = [int(x) for x in (args.fleets or "1,2").split(",")]
+        n_tasks = args.tasks or 12
+        results = [
+            _run_ingest_fleet(n, n_tasks, tmp, log, args.platform)
+            for n in fleets
+        ]
+        artifact = {
+            "metric": "gang_ingest_e2e_examples_per_sec",
+            "unit": "examples/sec",
+            "harness": (
+                f"cpu ({os.cpu_count()} core host), 1 fake device per "
+                "worker process, real jax.distributed gang"
+                if args.platform == "cpu" else "chip"
+            ),
+            "config": "deepfm AllReduce, criteo recordio via C++ bulk "
+                      "read + decode, fused scan + task pipelining + "
+                      "prep-ahead (group-eligible since r6)",
+            "fleets": results,
+            "note": "group-mode ingest was unmeasurable before r6 (the "
+                    "control-plane mode deliberately starves the data "
+                    "path); examples/sec is gang-aggregate — lockstep "
+                    "peers train the SAME tasks collectively, so the "
+                    "figure does not scale with fleet size, it must "
+                    "HOLD as the gang grows",
+        }
+        from tools.artifact import write_artifact
+
+        if args.platform == "chip":
+            # The module-scope cpu force aimed THIS (jax-free) process at
+            # cpu; the workers ran on the image's real backend.  Restore it
+            # before the artifact stamp — write_artifact records
+            # JAX_PLATFORMS as the provenance guard, and an on-chip number
+            # of record must not be stamped as a cpu smoke run.
+            if _CHIP_JAX_PLATFORMS:
+                os.environ["JAX_PLATFORMS"] = _CHIP_JAX_PLATFORMS
+            else:
+                os.environ.pop("JAX_PLATFORMS", None)
+        write_artifact(
+            artifact, "gang_ingest_r06.json", env_var="GANG_INGEST_OUT",
+            path=args.out or None, log=log,
+        )
+        print(json.dumps(artifact["fleets"]), flush=True)
+        return
+
+    fleets = [int(x) for x in (args.fleets or "1,2,4").split(",")]
+    results = [_run_fleet(n, args.tasks or 96, tmp, log) for n in fleets]
     # On this 1-core host every worker shares the CPU, so per-worker rate
     # falls ~1/N by CONTENTION alone; the control-plane bound is how much
     # of the AGGREGATE rate survives as workers multiply — a serializing
@@ -181,7 +435,9 @@ def main() -> None:
     }
     from tools.artifact import write_artifact
 
-    write_artifact(artifact, "multiworker_r05.json", path=args.out, log=log)
+    write_artifact(
+        artifact, "multiworker_r05.json", path=args.out or None, log=log
+    )
     print(json.dumps(artifact["fleets"]), flush=True)
 
 

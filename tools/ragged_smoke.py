@@ -24,6 +24,7 @@ import numpy as np  # noqa: E402
 from jax.sharding import NamedSharding  # noqa: E402
 from jax.sharding import PartitionSpec as P  # noqa: E402
 
+from elasticdl_tpu.common.jax_compat import shard_map  # noqa: E402
 from elasticdl_tpu.ops.embedding import (  # noqa: E402
     ParallelContext,
     embedding_lookup,
@@ -51,7 +52,7 @@ def main() -> None:
 
         return jax.value_and_grad(loss)(t)
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         fwd_bwd,
         mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis)),

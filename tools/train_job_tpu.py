@@ -78,9 +78,15 @@ def main() -> None:
 
     timeline = []  # (t, done_count)
     last = -1
+    phase_times: dict = {}  # worker_id -> {phase: cumulative seconds}
     while thread.is_alive():
         try:
-            done = master.servicer.JobStatus({})["done"]
+            status_now = master.servicer.JobStatus({})
+            done = status_now["done"]
+            # Cumulative per-worker phase decomposition (rides every
+            # ReportTaskResult/ReportCheckpoint); latest snapshot wins.
+            if status_now.get("phase_times"):
+                phase_times = status_now["phase_times"]
         except Exception:
             done = last
         if done != last:
@@ -90,6 +96,12 @@ def main() -> None:
                   file=sys.stderr, flush=True)
         time.sleep(0.2)
     thread.join()
+    try:  # final snapshot: the worker's last report lands before run() ends
+        final_status = master.servicer.JobStatus({})
+        if final_status.get("phase_times"):
+            phase_times = final_status["phase_times"]
+    except Exception:
+        pass
     t_total = time.time() - t_start
     if "error" in status_box:
         raise SystemExit(f"master failed: {status_box['error']}")
@@ -108,6 +120,30 @@ def main() -> None:
     ckpt_steps = sorted(
         int(s) for s in os.listdir(ckpt) if s.isdigit()
     ) if os.path.isdir(ckpt) else []
+
+    # Attribute the job wall to named worker phases (VERDICT r5 Weak #1:
+    # the 5.4x job-vs-bench gap was guessed, not measured).  The snapshot
+    # is cumulative seconds per phase per worker; the critical-path sum
+    # should land near the worker's share of wall_total_s — the remainder
+    # is boot/compile/exit and anything not yet instrumented.
+    from elasticdl_tpu.common.metrics import critical_path_seconds
+
+    phase_summary = None
+    if phase_times:
+        totals: dict = {}
+        for per_worker in phase_times.values():
+            for k, v in per_worker.items():
+                totals[k] = round(totals.get(k, 0.0) + float(v), 3)
+        crit = critical_path_seconds(totals)
+        phase_summary = {
+            "per_worker": phase_times,
+            "totals_s": totals,
+            "critical_path_s": round(crit, 1),
+            "critical_path_frac_of_wall": (
+                round(crit / t_total, 3) if t_total > 0 else None
+            ),
+        }
+
     result = {
         "metric": "full_train_job_e2e_examples_per_sec_per_chip",
         "value": round(eps) if eps else None,
@@ -118,6 +154,9 @@ def main() -> None:
         "records_per_task": RECORDS_PER_TASK,
         "warm_tasks_excluded": warm,
         "checkpoint_steps_on_disk": ckpt_steps,
+        # prep_wait / dispatch / step_wait / metrics / checkpoint / control
+        # (+ off-path checkpoint_bg) — see common/metrics.py PhaseTimers.
+        "phase_times": phase_summary,
         "stack": "Master(gRPC)+ProcessPodBackend worker on TPU, recordio "
                  "input via C++ bulk reader + preprocessing codec, "
                  "periodic+final checkpoints",
