@@ -111,40 +111,19 @@ def _watchdog() -> None:
 
 
 def _code_rev() -> str:
-    """Commit hash of the code producing this number (best-effort).
-
-    Stamped into every bench artifact so the best-run-wins record guard
-    can tell "a worse run of the same code" (keep the record) from "the
-    first run of NEW code" (the record must follow the code): without the
-    rev gate a genuine regression could never lower the number of record.
-    A dirty tree gets a "-dirty" suffix — uncommitted changes are NEW code
-    under the same HEAD, and two dirty runs may differ from each other
-    too, so dirty never matches anything (the guard's same_rev stays
-    False and the fresh run wins).  Untracked files count as dirt: a new
-    not-yet-added module is importable code the committed rev does not
-    describe (ignored files still don't count).
+    """Commit hash stamped into every bench artifact (tools/artifact.py
+    ``code_rev``: shared with graftlint's LINT artifact so bench and lint
+    trajectories key to the same revision ids).  The best-run-wins record
+    guard needs it to tell "a worse run of the same code" (keep the
+    record) from "the first run of NEW code" (the record must follow the
+    code) — see the guard in ``_emit`` for the dirty-rev rules.
     """
     try:
-        import subprocess
+        from tools.artifact import code_rev
 
-        repo = os.path.dirname(os.path.abspath(__file__))
-        out = subprocess.run(
-            ["git", "rev-parse", "HEAD"],
-            cwd=repo, capture_output=True, text=True, timeout=10,
-        )
-        if out.returncode != 0:
-            return ""
-        rev = out.stdout.strip()
-        st = subprocess.run(
-            ["git", "status", "--porcelain"],
-            cwd=repo, capture_output=True, text=True, timeout=10,
-        )
-        if st.returncode != 0 or st.stdout.strip():
-            rev += "-dirty"
-        return rev
+        return code_rev(os.path.dirname(os.path.abspath(__file__)))
     except Exception:
-        pass
-    return ""
+        return ""
 
 
 def _emit(

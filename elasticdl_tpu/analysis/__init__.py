@@ -1,0 +1,53 @@
+"""graftlint — repo-native static analysis.
+
+The r6 review rounds caught latent races and hot-path blockers *by hand*
+(rank-asymmetric checkpoint hooks, a raw shard_map call site bypassing
+``common/jax_compat.py``, blocking device reads at task boundaries).  This
+package encodes those invariants as AST passes so every future change is
+gated, not reviewed, into compliance:
+
+- ``lock-discipline``   attributes annotated ``# guarded-by: <lock>`` may
+                        only be touched inside ``with self.<lock>:``
+- ``hot-path-sync``     functions annotated ``# hot-path`` may not block
+                        (device syncs, sleeps, master RPCs) outside a
+                        ``phases.phase(...)`` accounting boundary
+- ``compat-shim``       raw ``shard_map`` / ``jax.distributed.initialize``
+                        / ``lax.axis_size`` only in ``common/jax_compat.py``
+- ``rpc-discipline``    stub call sites carry a timeout or route through a
+                        retry wrapper
+- ``thread-hygiene``    every ``threading.Thread`` is daemonized or joined
+- ``import-hygiene``    master/bench-process modules stay jax-free at
+                        import time (transitive)
+
+Inline waivers: ``# graftlint: allow[<rule>] <reason>`` — the reason is
+mandatory; malformed waivers are themselves findings (``waiver-syntax``).
+CLI driver: ``python tools/graftlint.py [paths...]``.  Pure stdlib — the
+linter must never pay (or hang on) a jax import.
+"""
+
+from elasticdl_tpu.analysis.compat_shim import CompatShimPass
+from elasticdl_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    LintPass,
+    SourceFile,
+    lint_text,
+    run_lint,
+)
+from elasticdl_tpu.analysis.hot_path import HotPathSyncPass
+from elasticdl_tpu.analysis.import_hygiene import ImportHygienePass
+from elasticdl_tpu.analysis.lock_discipline import LockDisciplinePass
+from elasticdl_tpu.analysis.rpc_discipline import RpcDisciplinePass
+from elasticdl_tpu.analysis.thread_hygiene import ThreadHygienePass
+
+
+def all_passes() -> list:
+    """One fresh instance of every pass (passes are stateless between runs,
+    but a fresh list keeps callers from accidentally sharing config)."""
+    return [
+        LockDisciplinePass(),
+        HotPathSyncPass(),
+        CompatShimPass(),
+        RpcDisciplinePass(),
+        ThreadHygienePass(),
+        ImportHygienePass(),
+    ]

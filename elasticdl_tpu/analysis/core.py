@@ -1,0 +1,326 @@
+"""graftlint core: source model, waivers, pass protocol, runner.
+
+Design constraints:
+
+- **Pure stdlib** (``ast`` + ``tokenize``): the linter gates tier-1 and
+  pre-commit; it must never pay — or hang on — a jax/grpc import.
+- **Comment conventions are the contract.**  Annotations ride comments
+  (``# guarded-by: _lock``, ``# hot-path``) because the invariants they
+  declare are about *runtime concurrency*, which the type system cannot
+  express, and because a comment on the declaring line keeps the
+  declaration next to the thing it protects.
+- **Waivers require a reason.**  ``# graftlint: allow[<rule>] <reason>``
+  on the finding's line (or a comment-only line directly above it).  A
+  waiver with no rule, an unknown rule, or no reason is itself a finding
+  (rule ``waiver-syntax``) — the escape hatch cannot silently rot.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence
+
+#: Every rule a waiver may name.  Passes register here at import; the
+#: waiver validator rejects anything else (typo'd waivers must fail loud,
+#: or they would silently waive nothing).
+KNOWN_RULES = {
+    "lock-discipline",
+    "hot-path-sync",
+    "compat-shim",
+    "rpc-discipline",
+    "thread-hygiene",
+    "import-hygiene",
+    "waiver-syntax",
+    # Unreadable / syntactically invalid files: not waivable (a broken file
+    # cannot carry a trustworthy waiver), but a distinct rule id so the
+    # artifact's per-rule counts don't misattribute them to waiver grammar.
+    "parse-error",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Waiver:
+    rule: str
+    reason: str
+    line: int
+
+
+#: Comments of the shape ``graftlint: <payload>`` (after a hash) mark
+#: waivers; the payload grammar is validated separately so malformed
+#: payloads become findings instead of silent no-ops.
+_WAIVER_MARK = re.compile(r"#\s*graftlint\s*:\s*(?P<payload>.*)$")
+_WAIVER_PAYLOAD = re.compile(
+    r"^allow\[(?P<rule>[^\]]*)\]\s*(?P<reason>.*)$"
+)
+
+_GUARDED_BY = re.compile(r"#\s*guarded-by\s*:\s*(?P<lock>[A-Za-z_][A-Za-z0-9_]*)")
+_HOT_PATH = re.compile(r"#\s*hot-path\b")
+
+
+class SourceFile:
+    """One parsed python file: AST + per-line comments + waivers.
+
+    ``path`` is the display path (repo-relative when linting the repo);
+    passes that exempt specific files (compat-shim) match on its suffix.
+    """
+
+    def __init__(self, path: str, text: str):
+        self.path = path
+        self.text = text
+        self.tree = ast.parse(text, filename=path)
+        #: line number -> full comment text (including the ``#``).  A line
+        #: holds at most one comment token.
+        self.comments: Dict[int, str] = {}
+        #: lines that contain ONLY a comment (a waiver there applies to the
+        #: next line down).
+        self.comment_only_lines: set = set()
+        self._scan_comments()
+        self.waivers: Dict[int, Waiver] = {}
+        self.waiver_errors: List[Finding] = []
+        self._parse_waivers()
+
+    def _scan_comments(self) -> None:
+        tokens = tokenize.generate_tokens(io.StringIO(self.text).readline)
+        lines = self.text.splitlines()
+        try:
+            for tok in tokens:
+                if tok.type == tokenize.COMMENT:
+                    row, col = tok.start
+                    self.comments[row] = tok.string
+                    if lines[row - 1][:col].strip() == "":
+                        self.comment_only_lines.add(row)
+        except tokenize.TokenError:
+            # ast.parse already accepted the file; an incidental tokenizer
+            # wobble (rare, e.g. on odd trailing bytes) degrades to "no
+            # comments seen", never to a crash of the whole lint run.
+            pass
+
+    def _parse_waivers(self) -> None:
+        for line, comment in self.comments.items():
+            m = _WAIVER_MARK.search(comment)
+            if m is None:
+                continue
+            payload = m.group("payload").strip()
+            pm = _WAIVER_PAYLOAD.match(payload)
+            if pm is None:
+                self.waiver_errors.append(Finding(
+                    "waiver-syntax", self.path, line,
+                    f"malformed waiver {payload!r}: expected "
+                    "'allow[<rule>] <reason>'",
+                ))
+                continue
+            rule = pm.group("rule").strip()
+            reason = pm.group("reason").strip()
+            if not rule:
+                self.waiver_errors.append(Finding(
+                    "waiver-syntax", self.path, line,
+                    "waiver names no rule: 'allow[]' must name the rule "
+                    "it waives",
+                ))
+                continue
+            if rule not in KNOWN_RULES:
+                self.waiver_errors.append(Finding(
+                    "waiver-syntax", self.path, line,
+                    f"waiver names unknown rule {rule!r} "
+                    f"(known: {', '.join(sorted(KNOWN_RULES))})",
+                ))
+                continue
+            if not reason:
+                self.waiver_errors.append(Finding(
+                    "waiver-syntax", self.path, line,
+                    f"waiver for {rule!r} carries no reason — every "
+                    "waiver must say why the rule does not apply",
+                ))
+                continue
+            self.waivers[line] = Waiver(rule, reason, line)
+
+    # -- annotation lookups --
+
+    def guarded_by(self, line: int) -> Optional[str]:
+        """Lock name from a ``# guarded-by: <lock>`` comment on ``line``."""
+        comment = self.comments.get(line)
+        if comment is None:
+            return None
+        m = _GUARDED_BY.search(comment)
+        return m.group("lock") if m else None
+
+    def is_hot_path(self, line: int) -> bool:
+        """``# hot-path`` marker on ``line`` or anywhere in the contiguous
+        block of comment-only lines directly above it (markers may wrap
+        onto multiple comment lines of prose)."""
+        comment = self.comments.get(line)
+        if comment is not None and _HOT_PATH.search(comment):
+            return True
+        cand = line - 1
+        while cand in self.comment_only_lines:
+            if _HOT_PATH.search(self.comments[cand]):
+                return True
+            cand -= 1
+        return False
+
+    def waived(self, finding: Finding) -> bool:
+        """A finding is waived by a matching-rule waiver on its own line or
+        on a comment-only line directly above it."""
+        for cand in (finding.line, finding.line - 1):
+            w = self.waivers.get(cand)
+            if w is None:
+                continue
+            if cand == finding.line - 1 and cand not in self.comment_only_lines:
+                continue
+            if w.rule == finding.rule:
+                return True
+        return False
+
+
+class LintPass:
+    """One rule.  Per-file passes implement ``run``; whole-project passes
+    (import-hygiene needs the module graph) implement ``run_project``."""
+
+    name: str = ""
+    description: str = ""
+
+    def run(self, src: SourceFile) -> Iterable[Finding]:
+        return ()
+
+    def run_project(self, files: Sequence[SourceFile]) -> Iterable[Finding]:
+        return ()
+
+
+# -- AST helpers shared by passes --
+
+def attr_chain(node: ast.AST) -> str:
+    """Dotted name of an attribute/name chain (``self.master.call`` ->
+    ``"self.master.call"``); ``""`` when the chain bottoms out in a call or
+    subscript (dynamic receiver)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def iter_file_paths(paths: Sequence[str]) -> List[str]:
+    """Every ``.py`` file under ``paths`` (files pass through), skipping
+    ``__pycache__`` and hidden directories, sorted for stable output."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(p)
+            continue
+        for root, dirs, names in os.walk(p):
+            dirs[:] = sorted(
+                d for d in dirs
+                if d != "__pycache__" and not d.startswith(".")
+            )
+            for name in sorted(names):
+                if name.endswith(".py"):
+                    out.append(os.path.join(root, name))
+    return sorted(dict.fromkeys(out))
+
+
+def load_sources(
+    file_paths: Sequence[str], rel_to: Optional[str] = None
+) -> tuple:
+    """Parse files into SourceFiles; unparseable files become findings (a
+    syntax error must fail the gate, not crash it).  Returns
+    ``(sources, error_findings)``."""
+    sources: List[SourceFile] = []
+    errors: List[Finding] = []
+    for fp in file_paths:
+        display = os.path.relpath(fp, rel_to) if rel_to else fp
+        try:
+            with open(fp, encoding="utf-8") as f:
+                text = f.read()
+        except (OSError, UnicodeDecodeError) as e:
+            errors.append(Finding("parse-error", display, 1, f"unreadable: {e}"))
+            continue
+        try:
+            sources.append(SourceFile(display, text))
+        except SyntaxError as e:
+            errors.append(Finding(
+                "parse-error", display, e.lineno or 1, f"syntax error: {e.msg}"
+            ))
+    return sources, errors
+
+
+def run_passes(
+    sources: Sequence[SourceFile],
+    passes: Sequence[LintPass],
+    only_paths: Optional[set] = None,
+) -> List[Finding]:
+    """All findings across ``sources``, waivers applied.  ``only_paths``
+    restricts *reporting* to those display paths (``--changed`` mode) while
+    project passes still see the whole file set."""
+    findings: List[Finding] = []
+    by_path = {s.path: s for s in sources}
+    for src in sources:
+        if only_paths is not None and src.path not in only_paths:
+            continue
+        # waiver-syntax findings are never waivable (a broken escape hatch
+        # must not be able to excuse itself).
+        findings.extend(src.waiver_errors)
+        for p in passes:
+            for f in p.run(src):
+                if not src.waived(f):
+                    findings.append(f)
+    for p in passes:
+        for f in p.run_project(sources):
+            src = by_path.get(f.path)
+            if src is not None and src.waived(f):
+                continue
+            if only_paths is not None and f.path not in only_paths:
+                continue
+            findings.append(f)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def run_lint(
+    paths: Sequence[str],
+    passes: Optional[Sequence[LintPass]] = None,
+    rel_to: Optional[str] = None,
+    only_paths: Optional[set] = None,
+) -> List[Finding]:
+    """Lint ``paths`` with ``passes`` (default: the full suite)."""
+    if passes is None:
+        from elasticdl_tpu.analysis import all_passes
+
+        passes = all_passes()
+    sources, errors = load_sources(iter_file_paths(paths), rel_to=rel_to)
+    if only_paths is not None:
+        # Changed-only mode scopes REPORTING, parse errors included — an
+        # out-of-scope broken file must not fail a scoped run.
+        errors = [f for f in errors if f.path in only_paths]
+    return sorted(
+        errors + run_passes(sources, passes, only_paths=only_paths),
+        key=lambda f: (f.path, f.line, f.rule),
+    )
+
+
+def lint_text(
+    text: str,
+    passes: Sequence[LintPass],
+    path: str = "fixture.py",
+) -> List[Finding]:
+    """Lint an in-memory snippet (the test-fixture entry point)."""
+    src = SourceFile(path, text)
+    return run_passes([src], passes)

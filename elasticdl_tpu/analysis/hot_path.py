@@ -1,0 +1,137 @@
+"""hot-path-sync: no silent blocking inside ``# hot-path`` functions.
+
+The r5/r6 perf work moved every blocking operation of the worker task loop
+(device syncs, metrics fetches, checkpoint writes, control RPCs) either off
+the critical path or behind a named ``PhaseTimers`` boundary, so each
+second of wall is attributable (docs/perf.md).  This pass keeps it that
+way: a function whose ``def`` line (or the comment line above it) carries
+``# hot-path`` may not, in its steady-state body, call
+
+- ``<x>.block_until_ready()`` / ``jax.block_until_ready(...)`` — drains the
+  dispatch pipeline;
+- ``<x>.item()`` — a blocking device->host scalar read;
+- ``jax.device_get(...)`` — blocking transfer;
+- ``int(...)`` / ``float(...)`` / ``np.asarray(...)`` over an expression
+  touching ``self.state`` — the classic accidental sync (``int(state.step)``
+  costs a full pipeline drain; use the python-side mirror);
+- ``time.sleep(...)``;
+- ``<...>master.call(...)`` — a blocking control-plane RPC.
+
+Designated boundaries are exempt, matching the runtime convention:
+
+- statements inside ``with <...>.phases.phase("name"):`` (or any
+  ``.phase(...)`` context) are *accounted* blocking — the boundary the
+  invariant text refers to;
+- ``except`` handler bodies (error paths are off the hot path; recovery is
+  allowed to settle state);
+- nested ``def``/``lambda`` bodies (deferred execution — background
+  threads own their own time).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from elasticdl_tpu.analysis.core import Finding, LintPass, SourceFile, attr_chain
+
+_CAST_CALLEES = {"int", "float"}
+_ASARRAY_CHAINS = {"np.asarray", "numpy.asarray", "np.array", "numpy.array"}
+
+
+def _references_state(node: ast.AST) -> bool:
+    """True when the expression touches ``self.state`` (device-backed train
+    state) anywhere in its subtree."""
+    for sub in ast.walk(node):
+        if (
+            isinstance(sub, ast.Attribute)
+            and sub.attr == "state"
+            and isinstance(sub.value, ast.Name)
+            and sub.value.id == "self"
+        ):
+            return True
+        if isinstance(sub, ast.Name) and sub.id == "state":
+            return True
+    return False
+
+
+def _is_phase_context(ctx: ast.expr) -> bool:
+    """``with self.phases.phase("x"):``-shaped context expression."""
+    return (
+        isinstance(ctx, ast.Call)
+        and isinstance(ctx.func, ast.Attribute)
+        and ctx.func.attr == "phase"
+    )
+
+
+class HotPathSyncPass(LintPass):
+    name = "hot-path-sync"
+    description = (
+        "functions marked '# hot-path' may not block (device syncs, "
+        "sleeps, master RPCs) outside a phases.phase(...) boundary"
+    )
+
+    def run(self, src: SourceFile) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if src.is_hot_path(node.lineno):
+                    self._walk(src, node.body, findings)
+        return findings
+
+    def _walk(self, src, body, findings) -> None:
+        for node in body:
+            self._visit(src, node, findings)
+
+    def _visit(self, src, node, findings) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return  # deferred execution: not this function's hot path
+        if isinstance(node, ast.With):
+            if any(_is_phase_context(i.context_expr) for i in node.items):
+                return  # accounted boundary: blocking here is by design
+            self._walk(src, node.body, findings)
+            return
+        if isinstance(node, ast.Try):
+            for stmt in node.body + node.orelse + node.finalbody:
+                self._visit(src, stmt, findings)
+            return  # handlers (error path) skipped
+        if isinstance(node, ast.Call):
+            self._check_call(src, node, findings)
+        for child in ast.iter_child_nodes(node):
+            self._visit(src, child, findings)
+
+    def _check_call(self, src, node: ast.Call, findings) -> None:
+        f = node.func
+        chain = attr_chain(f)
+        msg = None
+        if isinstance(f, ast.Attribute):
+            if f.attr == "block_until_ready" or chain == "jax.block_until_ready":
+                msg = "block_until_ready drains the dispatch pipeline"
+            elif f.attr == "item" and not node.args and not node.keywords:
+                msg = ".item() is a blocking device->host scalar read"
+            elif chain == "jax.device_get":
+                msg = "jax.device_get blocks on transfer"
+            elif chain == "time.sleep":
+                msg = "time.sleep stalls the hot path"
+            elif f.attr in ("call", "call_async") and chain:
+                recv = chain.rsplit(".", 1)[0].split(".")[-1]
+                if recv == "master":
+                    msg = "blocking master RPC on the hot path"
+            elif chain in _ASARRAY_CHAINS and any(
+                _references_state(a) for a in node.args
+            ):
+                msg = (
+                    f"{chain} over self.state forces a device->host copy"
+                )
+        elif isinstance(f, ast.Name) and f.id in _CAST_CALLEES:
+            if any(_references_state(a) for a in node.args):
+                msg = (
+                    f"{f.id}() over self.state is a blocking device read "
+                    "(use the python-side step mirror)"
+                )
+        if msg is not None:
+            findings.append(Finding(
+                self.name, src.path, node.lineno,
+                msg + " — move it behind a phases.phase(...) boundary, off "
+                "the hot path, or waive with a reason",
+            ))

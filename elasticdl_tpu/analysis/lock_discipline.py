@@ -1,0 +1,128 @@
+"""lock-discipline: annotated shared attributes only under their lock.
+
+Convention (docs/static_analysis.md): an attribute assignment carrying a
+``# guarded-by: <lock>`` comment — normally in ``__init__`` — registers the
+attribute as guarded by ``self.<lock>``.  Every other touch (load or store)
+of ``self.<attr>`` in that class must then sit lexically inside a
+``with self.<lock>:`` block, or inside a method whose ``def`` line carries
+the same ``# guarded-by: <lock>`` annotation (the *_locked helper pattern:
+the caller holds the lock).
+
+Deliberate scoping, matching the runtime semantics:
+
+- ``__init__`` is exempt: construction happens-before publication.
+- A nested ``def``/``lambda`` does NOT inherit the enclosing ``with``:
+  closures (background-thread bodies, callbacks) execute after the lock is
+  released, which is exactly the race class this pass exists to catch.
+- The analysis is lexical, per-class, and intra-procedural — a method that
+  takes the lock and then calls a helper is expressed by annotating the
+  helper's ``def`` line, not inferred.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List
+
+from elasticdl_tpu.analysis.core import Finding, LintPass, SourceFile
+
+
+def _self_attr(node: ast.AST):
+    """``self.X`` -> ``"X"``, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class LockDisciplinePass(LintPass):
+    name = "lock-discipline"
+    description = (
+        "attributes declared '# guarded-by: <lock>' may only be touched "
+        "inside 'with self.<lock>:'"
+    )
+
+    def run(self, src: SourceFile) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._check_class(src, node))
+        return findings
+
+    def _check_class(self, src: SourceFile, cls: ast.ClassDef) -> List[Finding]:
+        guarded: Dict[str, str] = {}  # attr -> lock name
+        for node in ast.walk(cls):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                lock = src.guarded_by(node.lineno)
+                if lock is None:
+                    continue
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr is not None:
+                        guarded[attr] = lock
+        if not guarded:
+            return []
+        findings: List[Finding] = []
+        for stmt in cls.body:
+            if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            held = set()
+            lock = src.guarded_by(stmt.lineno)
+            if lock is not None:
+                held.add(lock)
+            exempt = stmt.name == "__init__"
+            self._walk(src, stmt.body, guarded, held, exempt, findings)
+        return findings
+
+    def _walk(self, src, body, guarded, held, exempt, findings) -> None:
+        for node in body:
+            self._visit(src, node, guarded, held, exempt, findings)
+
+    def _visit(self, src, node, guarded, held, exempt, findings) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            # Deferred execution: the enclosing with-block's lock is NOT
+            # held when a closure runs.  A def-line annotation may re-assert
+            # it (a helper documented as called-with-lock-held).
+            inner_held = set()
+            lock = src.guarded_by(node.lineno)
+            if lock is not None:
+                inner_held.add(lock)
+            body = node.body if isinstance(node.body, list) else [node.body]
+            for child in body:
+                self._visit(src, child, guarded, inner_held, exempt, findings)
+            return
+        if isinstance(node, ast.With):
+            acquired = set()
+            for item in node.items:
+                ctx = item.context_expr
+                attr = _self_attr(ctx)
+                if attr is not None and attr in set(guarded.values()):
+                    acquired.add(attr)
+            new_held = held | acquired
+            for item in node.items:
+                self._visit(src, item.context_expr, guarded, held, exempt, findings)
+            self._walk(src, node.body, guarded, new_held, exempt, findings)
+            return
+        if isinstance(node, ast.Attribute):
+            attr = _self_attr(node)
+            if attr is not None and attr in guarded and not exempt:
+                lock = guarded[attr]
+                if lock not in held:
+                    # The declaring line itself (re-annotated elsewhere) is
+                    # still a touch; only __init__ is exempt by position.
+                    findings.append(Finding(
+                        self.name, src.path, node.lineno,
+                        f"self.{attr} is guarded by self.{lock} but touched "
+                        f"outside 'with self.{lock}:' (annotate the method "
+                        f"'# guarded-by: {lock}' if the caller holds it)",
+                    ))
+            # fall through: visit children (e.g. self.a.b -> self.a)
+        for child in ast.iter_child_nodes(node):
+            self._visit(src, child, guarded, held, exempt, findings)

@@ -1,0 +1,130 @@
+"""rpc-discipline: every stub call site has a deadline or a retry owner.
+
+A blocking RPC with no deadline wedges forever on a half-dead peer — the
+failure mode the death-push and PS-retry work exists to bound.  The rule:
+any call spelled ``<recv>.call(...)`` / ``<recv>.call_async(...)`` (the
+repo's two RPC entry-point names: JsonRpcClient / PSClient / the master
+proxies) and any direct gRPC stub invocation (``self._stubs[...](...)``)
+must satisfy one of:
+
+- an explicit ``timeout=`` / ``timeout_s=`` kwarg at the call site;
+- lexical containment in a designated retry/fan-out wrapper
+  (``RETRY_WRAPPER_FUNCS``) — those own both deadline and backoff;
+- being the body of a lambda passed to a ``_retry``-named wrapper
+  (``self._retry(lambda: c.call(...))`` — the wrapper drives it);
+- a receiver whose terminal name is in ``BOUNDARY_RECEIVERS`` — the master
+  proxies (``self.master.call``): ``RpcMasterProxy``/``JsonRpcClient`` own
+  the per-call deadline, and in-process ``DirectMasterProxy`` has no wire.
+
+``subprocess.call`` and ``super().call`` (proxy subclass delegating to the
+boundary-owning base) are out of scope by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from elasticdl_tpu.analysis.core import Finding, LintPass, SourceFile, attr_chain
+
+#: Functions that own retry + deadline for the calls inside them.
+RETRY_WRAPPER_FUNCS = {
+    "_retry",
+    "_call_shard",
+    "_fan_out",
+    "_retry_transient_collective",
+}
+
+#: Terminal receiver names whose ``.call`` is already a managed boundary.
+BOUNDARY_RECEIVERS = {"master", "subprocess"}
+
+_TIMEOUT_KWARGS = {"timeout", "timeout_s"}
+
+
+class RpcDisciplinePass(LintPass):
+    name = "rpc-discipline"
+    description = (
+        "stub .call/.call_async sites carry an explicit timeout or route "
+        "through a retry wrapper"
+    )
+
+    def run(self, src: SourceFile) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        self._walk(src, src.tree.body, in_wrapper=False, findings=findings)
+        return findings
+
+    def _walk(self, src, body, in_wrapper, findings) -> None:
+        for node in body:
+            self._visit(src, node, in_wrapper, findings)
+
+    def _visit(self, src, node, in_wrapper, findings) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._walk(
+                src, node.body,
+                in_wrapper or node.name in RETRY_WRAPPER_FUNCS,
+                findings,
+            )
+            return
+        if isinstance(node, ast.Call):
+            callee = node.func
+            callee_chain = attr_chain(callee)
+            is_retry_call = (
+                callee_chain.split(".")[-1] in RETRY_WRAPPER_FUNCS
+                if callee_chain else False
+            )
+            self._check_call(src, node, in_wrapper, findings)
+            for child in ast.iter_child_nodes(node):
+                if is_retry_call and isinstance(child, ast.Lambda):
+                    # The lambda body executes under the wrapper's retry
+                    # schedule: its calls are owned.
+                    self._visit(src, child.body, True, findings)
+                    continue
+                self._visit(src, child, in_wrapper, findings)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._visit(src, child, in_wrapper, findings)
+
+    def _is_stub_invocation(self, func: ast.expr) -> bool:
+        """``self._stubs[method](...)``-shaped direct stub call."""
+        return (
+            isinstance(func, ast.Subscript)
+            and isinstance(func.value, ast.Attribute)
+            and func.value.attr == "_stubs"
+        )
+
+    def _check_call(self, src, node: ast.Call, in_wrapper, findings) -> None:
+        func = node.func
+        is_rpc = False
+        label = ""
+        if isinstance(func, ast.Attribute) and func.attr in ("call", "call_async"):
+            chain = attr_chain(func)
+            if chain:
+                recv_terminal = chain.split(".")[-2] if "." in chain else chain
+                if recv_terminal in BOUNDARY_RECEIVERS:
+                    return
+            else:
+                # Dynamic receiver, e.g. ``super().call`` (proxy subclass
+                # delegating to the boundary-owning base) or
+                # ``clients[i].call`` — subscripted clients ARE stubs.
+                if isinstance(func.value, ast.Call):
+                    return  # super().call / factory().call: base owns it
+            is_rpc = True
+            label = f"{chain or '<dynamic>'}"
+        elif self._is_stub_invocation(func):
+            is_rpc = True
+            label = "direct stub invocation"
+        if not is_rpc:
+            return
+        if in_wrapper:
+            return
+        if any(
+            kw.arg in _TIMEOUT_KWARGS and kw.arg is not None
+            for kw in node.keywords
+        ):
+            return
+        findings.append(Finding(
+            self.name, src.path, node.lineno,
+            f"RPC {label} has no explicit timeout and no retry owner — "
+            "pass timeout_s=/timeout=, or route through "
+            f"{'/'.join(sorted(RETRY_WRAPPER_FUNCS))}",
+        ))

@@ -1,0 +1,100 @@
+"""thread-hygiene: every thread is daemonized or provably joined.
+
+A non-daemon thread that is never joined keeps the process alive after
+main exits — in this codebase that turns a clean worker RESTART exit into
+a hang the pod manager must SIGKILL out of (and a leaked prep/checkpoint
+thread can pin device buffers).  The rule: every ``threading.Thread(...)``
+constructor must either
+
+- pass ``daemon=True`` at construction, or
+- have a ``.join(...)`` call in the same lexical scope (function body, or
+  module top level for module-level threads) — the bench-tool
+  ``threads = [...]; for t in threads: t.start(); ... t.join()`` pattern,
+  or a ``<t>.daemon = True`` assignment in that scope.
+
+The join-proof is scope-local and name-blind (it accepts any ``x.join()``
+in the scope that is not a string/``os.path`` join): a cross-function
+hand-off (constructed here, joined elsewhere) is expressed with a waiver
+naming the join site.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from elasticdl_tpu.analysis.core import Finding, LintPass, SourceFile, attr_chain
+
+
+def _is_thread_ctor(node: ast.Call) -> bool:
+    chain = attr_chain(node.func)
+    return chain == "threading.Thread" or (
+        isinstance(node.func, ast.Name) and node.func.id == "Thread"
+    )
+
+
+def _has_daemon_true(node: ast.Call) -> bool:
+    for kw in node.keywords:
+        if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+            return kw.value.value is True
+    return False
+
+
+def _scope_has_join_or_daemon_set(scope: ast.AST) -> bool:
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr == "join":
+                recv = node.func.value
+                # Exclude the two common non-thread joins: "sep".join(...)
+                # and os.path.join(...).
+                if isinstance(recv, ast.Constant):
+                    continue
+                if attr_chain(recv).endswith("path"):
+                    continue
+                return True
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and t.attr == "daemon":
+                    return True
+    return False
+
+
+class ThreadHygienePass(LintPass):
+    name = "thread-hygiene"
+    description = (
+        "threading.Thread must be daemonized at construction or joined in "
+        "the same scope"
+    )
+
+    def run(self, src: SourceFile) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        self._check_scope(src, src.tree, findings)
+        return findings
+
+    def _check_scope(self, src, scope, findings) -> None:
+        # Per lexical scope: collect this scope's Thread ctors (not those
+        # of nested functions), then recurse into nested functions.
+        nested = []
+        ctors: List[ast.Call] = []
+        stack = list(
+            scope.body if isinstance(scope.body, list) else [scope.body]
+        )
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                nested.append(node)
+                continue
+            if isinstance(node, ast.Call) and _is_thread_ctor(node):
+                ctors.append(node)
+            stack.extend(ast.iter_child_nodes(node))
+        bad = [c for c in ctors if not _has_daemon_true(c)]
+        if bad and not _scope_has_join_or_daemon_set(scope):
+            for c in bad:
+                findings.append(Finding(
+                    self.name, src.path, c.lineno,
+                    "thread is neither daemonized (daemon=True) nor joined "
+                    "in this scope — a leaked non-daemon thread blocks "
+                    "process exit",
+                ))
+        for fn in nested:
+            self._check_scope(src, fn, findings)

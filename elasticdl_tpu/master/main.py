@@ -22,9 +22,14 @@ from typing import Dict, List, Optional
 
 from elasticdl_tpu.common.config import JobConfig, parse_args
 from elasticdl_tpu.common.log_utils import get_logger
-from elasticdl_tpu.common.platform import apply_platform_env
 
-apply_platform_env()
+# Deliberately NO apply_platform_env() here: that helper imports jax when
+# JAX_PLATFORMS is set, and the master is a pure control-plane process that
+# must stay jax-free (graftlint import-hygiene; the runtime twin in
+# tests/test_graftlint.py caught the old module-level call pulling jax —
+# ~13 s of import on the relaunch path and a possible hang on the tunneled
+# chip plugin, for a process that never runs a computation).  Worker/PS
+# subprocesses assert their own platform at startup.
 from elasticdl_tpu.data.reader import create_data_reader
 from elasticdl_tpu.master.evaluation_service import EvaluationService
 from elasticdl_tpu.master.pod_manager import (

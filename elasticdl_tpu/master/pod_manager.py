@@ -99,8 +99,8 @@ class FakePodBackend(PodBackend):
     """In-memory backend; tests inject pod events (mock-k8s pattern)."""
 
     def __init__(self, auto_run: bool = True):
-        self.pods: Dict[str, str] = {}  # name -> phase
-        self.start_log: List[str] = []
+        self.pods: Dict[str, str] = {}  # name -> phase; guarded-by: _lock
+        self.start_log: List[str] = []  # guarded-by: _lock
         self._auto_run = auto_run
         self._lock = threading.Lock()
 
@@ -162,12 +162,12 @@ class ProcessPodBackend(PodBackend):
         log_dir: Optional[str] = None,
     ):
         self._argv = argv or [sys.executable, "-m", "elasticdl_tpu.worker.main"]
-        self._procs: Dict[str, subprocess.Popen] = {}
+        self._procs: Dict[str, subprocess.Popen] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         self._poll = poll_interval_s
         self._inherit = inherit_env
         self._stop = threading.Event()
-        self._watcher: Optional[threading.Thread] = None
+        self._watcher: Optional[threading.Thread] = None  # guarded-by: _lock
         self._warm = warm_standby
         self._pool_size = max(1, standby_pool)
         # Per-pod log capture (the process-backend analog of kubectl logs):
@@ -176,9 +176,9 @@ class ProcessPodBackend(PodBackend):
         # no extra counter is needed.  None = inherit the parent's stdio.
         self._log_dir = log_dir
         # Parked spares: [(proc, go_file, env_signature)].
-        self._standby: List[tuple] = []
-        self._standby_dir: Optional[str] = None
-        self._standby_seq = 0
+        self._standby: List[tuple] = []  # guarded-by: _lock
+        self._standby_dir: Optional[str] = None  # guarded-by: _lock
+        self._standby_seq = 0  # guarded-by: _lock
 
     def _pod_stdio(self, name: str):
         if self._log_dir is None:
@@ -211,7 +211,7 @@ class ProcessPodBackend(PodBackend):
         except Exception:  # pragma: no cover — SIGKILL'd procs reap fast
             pass
 
-    def _prune_spares_locked(self, sig) -> None:
+    def _prune_spares_locked(self, sig) -> None:  # guarded-by: _lock
         """Drop dead spares; kill + drop spares whose job env changed."""
         keep = []
         for proc, go_file, s in self._standby:
@@ -652,16 +652,16 @@ class PodManager:
         self._env = dict(worker_env or {})
         self._prefix = name_prefix or f"{config.job_name}-worker"
         self._lock = threading.Lock()
-        self._slots: Dict[int, Optional[PodInfo]] = {}
-        self._by_name: Dict[str, PodInfo] = {}
+        self._slots: Dict[int, Optional[PodInfo]] = {}  # guarded-by: _lock
+        self._by_name: Dict[str, PodInfo] = {}  # guarded-by: _lock
         # Per-slot launch generation, NEVER reset (survives scale-down/up
         # cycles): every pod a slot ever gets has a unique name, so late
         # events for a retired pod can't resolve to its successor and a k8s
         # backend can't hit a name conflict with a terminating pod.
-        self._slot_gen: Dict[int, int] = {}
-        self._desired = 0
+        self._slot_gen: Dict[int, int] = {}  # guarded-by: _lock
+        self._desired = 0  # guarded-by: _lock
         self._listeners: List[PodListener] = []
-        self._retry_timers: List[threading.Timer] = []
+        self._retry_timers: List[threading.Timer] = []  # guarded-by: _lock
         self._relaunch = config.relaunch_on_worker_failure
         self._max_relaunch = config.max_worker_relaunch
         backend.set_event_callback(self._on_event)
@@ -750,7 +750,7 @@ class PodManager:
                 self._retry_timers.append(timer)
             timer.start()
 
-    def _new_pod_locked(self, slot: int, relaunches: int) -> PodInfo:
+    def _new_pod_locked(self, slot: int, relaunches: int) -> PodInfo:  # guarded-by: _lock
         gen = self._slot_gen.get(slot, -1) + 1
         self._slot_gen[slot] = gen
         suffix = f"-r{gen}" if gen else ""

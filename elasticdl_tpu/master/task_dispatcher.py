@@ -193,6 +193,7 @@ class TaskDispatcher:
 
     # -- worker-facing API (via servicer) --
 
+    # hot-path: behind every worker GetTask poll
     def get_task(self, worker_id: str) -> Optional[Task]:
         """Hand out the next task, or None if nothing is available.
 
@@ -209,6 +210,7 @@ class TaskDispatcher:
         self._fire_epoch_end()
         return task
 
+    # hot-path: behind every task report
     def report(self, task_id: int, success: bool, worker_id: str = "") -> bool:
         """Record a task result; requeue on failure.  Returns False for an
         unknown/stale id (e.g. a task already requeued by the timeout path —

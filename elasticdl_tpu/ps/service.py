@@ -269,8 +269,11 @@ class PSServer:
         self._locks = {key: _RWLock() for key in self._stores}
         # Step this shard restored at (re)start, or None: surfaced in Stats
         # so workers can verify the whole fleet restored the SAME step (a
-        # shard-divergent restore silently mixes model versions).
-        self.restored_step: Optional[int] = None
+        # shard-divergent restore silently mixes model versions).  Written
+        # by a Load handler thread, read by concurrent Stats handlers — a
+        # leaf lock makes the hand-off explicit (graftlint lock-discipline).
+        self._meta_lock = threading.Lock()
+        self.restored_step: Optional[int] = None  # guarded-by: _meta_lock
         # Message-size limits must cover production batches: a full 8192x26
         # dim-8 push is ~8.5 MB of frame, over gRPC's 4 MB default — the
         # server AND the client (PSClient) both raise the cap, or a
@@ -318,6 +321,7 @@ class PSServer:
             )
         return arr
 
+    # hot-path: the steady-state embedding read, once per step per worker
     def _pull(self, meta, arrays):
         store = self._store_for(meta)
         ids = self._require(arrays, "ids", np.int64)
@@ -331,6 +335,7 @@ class PSServer:
                 rows = store.pull(ids)
         return {}, {"rows": rows}
 
+    # hot-path: the per-step gradient apply
     def _push_grad(self, meta, arrays):
         store = self._store_for(meta)
         ids = self._require(arrays, "ids", np.int64)
@@ -413,16 +418,19 @@ class PSServer:
         with self._all_write_locks():
             for key, path in paths.items():
                 self._stores[key].load(path)
-        self.restored_step = int(meta["step"])
+        with self._meta_lock:
+            self.restored_step = int(meta["step"])
         return {"loaded": True}, {}
 
     def _stats(self, meta, arrays):
+        with self._meta_lock:
+            restored = self.restored_step
         return {
             "shard": self.shard,
             "num_shards": self.num_shards,
             "tables": {k: len(s) for k, s in self._stores.items()},
             # None = fresh stores (nothing restored since (re)start).
-            "restored_step": self.restored_step,
+            "restored_step": restored,
         }, {}
 
     # -- plumbing --
@@ -613,7 +621,11 @@ class RemoteEmbeddingStore:
     def __len__(self) -> int:
         total = 0
         for c in self._clients:
-            meta, _ = c.call("Stats", {})
+            # Through the transient-outage retry like every other shard
+            # call: a len() probe landing inside a shard's relaunch window
+            # must wait the seconds out, not fail the caller (graftlint
+            # rpc-discipline surfaced this as the one bare stub call).
+            meta, _ = self._retry(lambda c=c: c.call("Stats", {}))
             total += int(meta["tables"].get(self.table, 0))
         return total
 
@@ -702,7 +714,13 @@ class RemoteEmbeddingStore:
         # not fail the worker's task.  Save is idempotent (atomic per-file
         # replace), so a retry after a lost response just rewrites the file.
         meta = {"directory": directory, "step": int(step), "keep_max": keep_max}
-        futs = [c.call_async("Save", meta) for c in self._clients]
+        # Explicit deadline (the parallel fan-out has no retry wrapper
+        # around the futures themselves): a Save is a full-slice disk dump,
+        # so it gets headroom over the default RPC timeout — a shard that
+        # cannot finish inside it falls to the per-shard retry below.
+        futs = [
+            c.call_async("Save", meta, timeout_s=120.0) for c in self._clients
+        ]
         for s, fut in enumerate(futs):
             try:
                 fut.result()

@@ -59,12 +59,27 @@ class DirectMasterProxy:
 
 
 class RpcMasterProxy:
-    def __init__(self, address: str, timeout_s: float = 30.0):
+    """The worker's wire boundary to the master: every ``master.call`` in
+    this file funnels here, so the per-call deadline lives here (graftlint
+    rpc-discipline treats ``master``-terminal receivers as owned by this
+    proxy).  A master RPC that outlives the deadline surfaces as an error
+    at the call site instead of wedging the task loop forever on a
+    half-dead master."""
+
+    def __init__(
+        self,
+        address: str,
+        timeout_s: float = 30.0,
+        call_timeout_s: float = 60.0,
+    ):
         self._client = JsonRpcClient(address)
         self._client.wait_ready(timeout_s)
+        self._call_timeout_s = call_timeout_s
 
     def call(self, method: str, request: dict) -> dict:
-        return self._client.call(method, request)
+        return self._client.call(
+            method, request, timeout_s=self._call_timeout_s
+        )
 
 
 def _minibatches(
@@ -137,7 +152,12 @@ class Worker:
         self._group_mode = False
         self._task_seq = 0
         self._ckpt: Optional[CheckpointManager] = None
-        self._last_ckpt_step = 0
+        # Checkpoint watermark + background-save thread handle: touched by
+        # the task loop, the background save thread (failure rollback), and
+        # the preemption thread.  The leaf lock makes the hand-off explicit
+        # (graftlint lock-discipline); nothing blocking ever runs under it.
+        self._ckpt_lock = threading.Lock()
+        self._last_ckpt_step = 0  # guarded-by: _ckpt_lock
         self.reforms = 0  # elastic mesh re-formations (observability/tests)
         self._training_tasks_done = 0  # gates the one-task profiler trace
         # Task-level pipeline: the previous training task's (report, device
@@ -164,7 +184,7 @@ class Worker:
         self._parked = False
         # Background periodic-checkpoint machinery (_save_snapshot_background
         # / _save_group_snapshot_background)
-        self._ckpt_thread = None
+        self._ckpt_thread = None  # guarded-by: _ckpt_lock
         self._snapshot_fn = None
         # Per-phase wall decomposition of the task loop (common/metrics.py
         # PhaseTimers); snapshots ride every report so the master and the
@@ -439,6 +459,8 @@ class Worker:
 
     # ---- checkpointing ----
 
+    # hot-path: runs at every task boundary; the step mirror below exists
+    # precisely so this never reads the device
     def _maybe_checkpoint(self) -> None:
         if self._ckpt is None or self.config.checkpoint_steps <= 0:
             return
@@ -448,7 +470,9 @@ class Worker:
         # mirror equals the step the live state settles to (every dispatched
         # step applies to it), which is the step the snapshot will carry.
         step = self._steps_dispatched
-        if step - self._last_ckpt_step < self.config.checkpoint_steps:
+        with self._ckpt_lock:
+            behind = step - self._last_ckpt_step
+        if behind < self.config.checkpoint_steps:
             return
         with self.phases.phase("checkpoint"):
             if self._group_mode:
@@ -464,7 +488,8 @@ class Worker:
         state = self.state if state is None else state
         self._ckpt.save(step, jax.device_get(state), wait=wait)
         self.trainer.save_host_stores(self._ckpt.directory, step)
-        self._last_ckpt_step = step
+        with self._ckpt_lock:
+            self._last_ckpt_step = step
         self.master.call(
             "ReportCheckpoint",
             {
@@ -476,10 +501,13 @@ class Worker:
         )
 
     def _join_ckpt(self, timeout: float = None) -> None:
-        t = self._ckpt_thread
+        with self._ckpt_lock:
+            t = self._ckpt_thread
         if t is not None and t.is_alive():
-            t.join(timeout)
+            t.join(timeout)  # outside the lock: the join itself may block
 
+    # hot-path: dispatch-only by design — the whole point is that the
+    # boundary pays a dispatch RTT, never a drain
     def _snapshot_state(self):
         """ONE jitted device-side copy of the live state: fresh buffers no
         later step can donate (copy_to_host_async on the live state would
@@ -508,7 +536,8 @@ class Worker:
         the next boundary retries."""
         self._join_ckpt()
         snap = self._snapshot_state()
-        prev_watermark, self._last_ckpt_step = self._last_ckpt_step, step
+        with self._ckpt_lock:
+            prev_watermark, self._last_ckpt_step = self._last_ckpt_step, step
 
         def _bg():
             try:
@@ -519,10 +548,12 @@ class Worker:
                     "background checkpoint at step %d failed; next "
                     "boundary retries", step,
                 )
-                self._last_ckpt_step = prev_watermark
+                with self._ckpt_lock:
+                    self._last_ckpt_step = prev_watermark
 
         t = threading.Thread(target=_bg, name="edl-ckpt", daemon=True)
-        self._ckpt_thread = t
+        with self._ckpt_lock:
+            self._ckpt_thread = t
         t.start()
 
     def _save_group_snapshot_background(self, step: int) -> None:
@@ -550,7 +581,8 @@ class Worker:
         """
         self._join_ckpt()
         snap = self._snapshot_state()
-        self._last_ckpt_step = step
+        with self._ckpt_lock:
+            self._last_ckpt_step = step
 
         def _bg():
             try:
@@ -582,7 +614,8 @@ class Worker:
                 )
 
         t = threading.Thread(target=_bg, name="edl-ckpt", daemon=True)
-        self._ckpt_thread = t
+        with self._ckpt_lock:
+            self._ckpt_thread = t
         t.start()
 
     def preemption_snapshot(self) -> bool:
@@ -668,7 +701,9 @@ class Worker:
             # (bounded inside the grace window) — both the same-step
             # collision check and a fresh save need it durable.
             self._join_ckpt(timeout=10.0)
-            if self._ckpt_thread is not None and self._ckpt_thread.is_alive():
+            with self._ckpt_lock:
+                bg = self._ckpt_thread
+            if bg is not None and bg.is_alive():
                 # Still saving after the bounded join: a fresh save here
                 # would interleave with it on the same manager/step dirs
                 # (tearing both), and waiting longer blows the grace
@@ -680,7 +715,9 @@ class Worker:
                     "after 10s join; exiting without a fresh snapshot",
                 )
                 return False
-            if self._last_ckpt_step == step:
+            with self._ckpt_lock:
+                saved_this_step = self._last_ckpt_step == step
+            if saved_this_step:
                 # The flush above crossed the periodic-checkpoint threshold
                 # and already saved THIS step (async): saving again would
                 # collide on the step dir, and exiting now would tear the
@@ -753,6 +790,8 @@ class Worker:
             stacked = self._stack_full_minibatches(records, mb, n_full)
         return records, stacked, n_full
 
+    # hot-path: THE dispatch function — every blocking transfer here shows
+    # up as device idle on the remote-attached chip
     def _dispatch_training_task(self, task: Task, prep: tuple = None) -> tuple:
         """Dispatch every device step of a training task WITHOUT blocking on
         results.  Returns (per-batch device metrics, n_steps).
@@ -908,6 +947,8 @@ class Worker:
             "no restorable checkpoint; training state re-initialized fresh"
         )
 
+    # hot-path: the one deliberate drain per task — both blocking halves
+    # sit inside their named phase boundaries
     def _finalize_training_metrics(self, metrics_list) -> Dict[str, float]:
         """ONE device_get of the whole task's per-batch metrics, then host
         aggregation — per-batch device adds or per-scalar fetches would cost
@@ -966,6 +1007,8 @@ class Worker:
     )
     _GROUP_TASK_ATTEMPTS = 3
 
+    # hot-path: wraps every dispatch; the retry sleep lives on the
+    # exception path only
     def _retry_transient_collective(self, fn, task_id: int):
         """Run a task's device work; in group mode, retry the transient
         collective-formation failures above in place.  _dispatch_training_task
@@ -1023,6 +1066,7 @@ class Worker:
             f"({context}); deregistered for group resync"
         )
 
+    # hot-path: the report RPC is accounted under the metrics phase
     def _report_result(self, report: dict) -> None:
         """ReportTaskResult with the cumulative phase decomposition riding
         along (the master's JobStatus and the train-job artifact read it)."""
@@ -1030,6 +1074,7 @@ class Worker:
         with self.phases.phase("metrics"):
             self.master.call("ReportTaskResult", report)
 
+    # hot-path: settles the PREVIOUS task while this one's steps run
     def _flush(self, pending: Optional[tuple]) -> None:
         """Settle a pipelined task: fetch its device metrics, report (rank 0
         only in group mode — peers ran the same collectives but exactly one
@@ -1113,6 +1158,7 @@ class Worker:
             and not self.config.profile_dir
         )
 
+    # hot-path: submission only — the prep itself runs on the pool thread
     def _submit_prep(self, task: Task):
         if self._prep_pool is None:
             self._prep_pool = ThreadPoolExecutor(
@@ -1120,6 +1166,8 @@ class Worker:
             )
         return self._prep_pool.submit(self._prep_fused_host, task)
 
+    # hot-path: the pipelined steady state — prep wait and the previous
+    # task's settle are the only (phase-accounted) blocking points
     def _dispatch_prepped(self, prepped: tuple) -> None:
         """Dispatch a prepped task's device work, rotate it into the
         pending (report-deferred) slot, and settle the PREVIOUS pending
@@ -1291,6 +1339,8 @@ class Worker:
 
     # ---- main loop ----
 
+    # hot-path: the task loop itself — every deliberate blocking point is
+    # either phase-accounted or individually waived with its reason
     def run(self, membership: Optional[dict] = None) -> Dict[str, Any]:
         """Main loop.  ``membership`` is the view returned by an EARLIER
         RegisterWorker call (worker.main registers once, derives the
@@ -1300,6 +1350,7 @@ class Worker:
         Without it (single-process tests, in-process workers) we register
         here."""
         if membership is None:
+            # graftlint: allow[hot-path-sync] one-time registration before the loop starts
             membership = self.master.call(
                 "RegisterWorker",
                 {
@@ -1362,6 +1413,7 @@ class Worker:
                     )
 
         self._tasks_done = 0
+        # graftlint: allow[hot-path-sync] one-time mirror seed before the loop; the restore above already settled the state
         self._steps_dispatched = int(self.state.step)
         while True:
             if self._preempting:
@@ -1379,6 +1431,7 @@ class Worker:
                 # Give an undispatched prepped task straight back to the
                 # master (it must not start device work now), then park.
                 self._abandon_prep()
+                # graftlint: allow[hot-path-sync] parked for preemption: the loop must only idle here
                 time.sleep(self._poll)
                 continue
             with self.phases.phase("control"):
@@ -1403,6 +1456,7 @@ class Worker:
             if self._group_mode and resp.get("stale"):
                 # World changed under us: the next membership check
                 # raises WorkerRestartRequired.
+                # graftlint: allow[hot-path-sync] stale lockstep world: no work to overlap until the re-form
                 time.sleep(self._poll)
                 continue
             if resp["task"] is None:
@@ -1414,6 +1468,7 @@ class Worker:
                 # model_version) until they land, and idling on unreported
                 # tasks would eventually look like a timeout/requeue.
                 self._drain_prep()
+                # graftlint: allow[hot-path-sync] dispatcher idle: nothing to dispatch, the poll IS the work
                 time.sleep(self._poll)
                 continue
             task = Task.from_dict(resp["task"])
@@ -1496,11 +1551,14 @@ class Worker:
                         )
                     finally:
                         if profiling:
+                            # graftlint: allow[hot-path-sync] a profiled task is traced in isolation; the trace must capture the drain
                             jax.block_until_ready(self.state)
                             jax.profiler.stop_trace()
                     self._training_tasks_done += 1
                     report["metrics"] = metrics
+                    # graftlint: allow[hot-path-sync] synchronous (non-pipelined) mode settles every task by design
                     report["model_version"] = int(self.state.step)
+                    # graftlint: allow[hot-path-sync] synchronous-mode mirror resync, same settle as the line above
                     self._steps_dispatched = int(self.state.step)
                 elif task.type == TASK_EVALUATION:
                     # Settle the pipelined train tasks first: their reports
@@ -1573,6 +1631,7 @@ class Worker:
                     )
         return {
             "tasks_done": self._tasks_done,
+            # graftlint: allow[hot-path-sync] job-end summary; everything is already settled
             "step": int(self.state.step) if self.state is not None else 0,
             "reforms": self.reforms,
             # The task loop's wall decomposition (common/metrics.PhaseTimers)

@@ -1,0 +1,524 @@
+"""graftlint: each pass catches its seeded fixture violation (and passes
+the clean twin), waiver syntax is enforced, and the REPO ITSELF lints
+clean — tier-1 is the enforcement gate the invariants ride on."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from elasticdl_tpu.analysis import all_passes
+from elasticdl_tpu.analysis.compat_shim import CompatShimPass
+from elasticdl_tpu.analysis.core import SourceFile, lint_text, run_lint, run_passes
+from elasticdl_tpu.analysis.hot_path import HotPathSyncPass
+from elasticdl_tpu.analysis.import_hygiene import ImportHygienePass
+from elasticdl_tpu.analysis.lock_discipline import LockDisciplinePass
+from elasticdl_tpu.analysis.rpc_discipline import RpcDisciplinePass
+from elasticdl_tpu.analysis.thread_hygiene import ThreadHygienePass
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint(src: str, passes) -> list:
+    return lint_text(textwrap.dedent(src), passes)
+
+
+def _rules(findings) -> set:
+    return {f.rule for f in findings}
+
+
+# ---- lock-discipline ----
+
+LOCK_SEEDED = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._count = 0  # guarded-by: _lock
+
+        def bump(self):
+            self._count += 1  # race: no lock held
+"""
+
+LOCK_CLEAN = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._count = 0  # guarded-by: _lock
+
+        def bump(self):
+            with self._lock:
+                self._count += 1
+
+        def _bump_locked(self):  # guarded-by: _lock
+            self._count += 1
+"""
+
+
+def test_lock_discipline_flags_unguarded_touch():
+    findings = _lint(LOCK_SEEDED, [LockDisciplinePass()])
+    assert len(findings) == 1
+    assert findings[0].rule == "lock-discipline"
+    assert "_count" in findings[0].message
+
+
+def test_lock_discipline_clean_twin():
+    assert _lint(LOCK_CLEAN, [LockDisciplinePass()]) == []
+
+
+def test_lock_discipline_closure_does_not_inherit_with_block():
+    # A closure runs AFTER the with-block releases the lock: the classic
+    # background-thread race must be flagged even though the def sits
+    # lexically inside the locked region.
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._x = 0  # guarded-by: _lock
+
+            def go(self):
+                with self._lock:
+                    def bg():
+                        self._x += 1
+                    t = threading.Thread(target=bg, daemon=True)
+                t.start()
+    """
+    findings = _lint(src, [LockDisciplinePass()])
+    assert len(findings) == 1 and "_x" in findings[0].message
+
+
+# ---- hot-path-sync ----
+
+HOT_SEEDED = """
+    import time
+
+    class W:
+        # hot-path: the dispatch loop
+        def dispatch(self):
+            time.sleep(0.1)
+"""
+
+HOT_CLEAN = """
+    import time
+
+    class W:
+        # hot-path: the dispatch loop
+        def dispatch(self):
+            with self.phases.phase("control"):
+                self.master.call("GetTask", {})
+
+        def not_hot(self):
+            time.sleep(0.1)
+"""
+
+
+def test_hot_path_flags_sleep():
+    findings = _lint(HOT_SEEDED, [HotPathSyncPass()])
+    assert _rules(findings) == {"hot-path-sync"}
+
+
+def test_hot_path_clean_twin_phase_boundary_and_unmarked():
+    # Blocking inside a phases.phase(...) boundary is accounted-by-design;
+    # unmarked functions are out of scope.
+    assert _lint(HOT_CLEAN, [HotPathSyncPass()]) == []
+
+
+def test_hot_path_device_reads_and_rpc_flagged():
+    src = """
+        class W:
+            # hot-path
+            def f(self):
+                x = self.metrics.item()
+                y = int(self.state.step)
+                self.master.call("Report", {})
+    """
+    findings = _lint(src, [HotPathSyncPass()])
+    assert len(findings) == 3
+
+
+def test_hot_path_except_handler_exempt():
+    src = """
+        import time
+
+        class W:
+            # hot-path
+            def f(self):
+                try:
+                    self.go()
+                except Exception:
+                    time.sleep(1.0)  # error path: off the hot path
+    """
+    assert _lint(src, [HotPathSyncPass()]) == []
+
+
+# ---- compat-shim ----
+
+SHIM_SEEDED = """
+    from jax.experimental.shard_map import shard_map
+
+    def f(mesh):
+        return shard_map(lambda x: x, mesh=mesh)
+"""
+
+SHIM_CLEAN = """
+    from elasticdl_tpu.common.jax_compat import axis_size, shard_map
+
+    def f(mesh):
+        return shard_map(lambda x: x, mesh=mesh)
+"""
+
+
+def test_compat_shim_flags_raw_import():
+    findings = _lint(SHIM_SEEDED, [CompatShimPass()])
+    assert _rules(findings) == {"compat-shim"}
+
+
+def test_compat_shim_clean_twin():
+    assert _lint(SHIM_CLEAN, [CompatShimPass()]) == []
+
+
+def test_compat_shim_flags_attr_spellings_but_not_in_shim_module():
+    src = """
+        import jax
+        from jax import lax
+
+        def f():
+            jax.distributed.initialize(coordinator_address="x")
+            return lax.axis_size("dp")
+    """
+    findings = _lint(src, [CompatShimPass()])
+    assert len(findings) == 2
+    # The shim module itself is the one place allowed to spell these.
+    clean = lint_text(
+        textwrap.dedent(src), [CompatShimPass()],
+        path="elasticdl_tpu/common/jax_compat.py",
+    )
+    assert clean == []
+
+
+# ---- rpc-discipline ----
+
+RPC_SEEDED = """
+    class Store:
+        def probe(self):
+            return self._client.call("Stats", {})
+"""
+
+RPC_CLEAN = """
+    class Store:
+        def probe(self):
+            return self._client.call("Stats", {}, timeout_s=5.0)
+
+        def _retry(self, fn):
+            return fn()
+
+        def pull(self):
+            return self._retry(lambda: self._client.call("Pull", {}))
+
+        def inside_wrapper(self):
+            # wrapper functions own deadline+backoff for their bodies
+            pass
+
+        def via_master(self):
+            return self.master.call("GetTask", {})  # proxy owns the deadline
+
+        def not_rpc(self):
+            import subprocess
+            return subprocess.call(["true"])
+"""
+
+
+def test_rpc_discipline_flags_bare_stub_call():
+    findings = _lint(RPC_SEEDED, [RpcDisciplinePass()])
+    assert _rules(findings) == {"rpc-discipline"}
+
+
+def test_rpc_discipline_clean_twin():
+    assert _lint(RPC_CLEAN, [RpcDisciplinePass()]) == []
+
+
+# ---- thread-hygiene ----
+
+THREAD_SEEDED = """
+    import threading
+
+    def leak():
+        threading.Thread(target=print).start()
+"""
+
+THREAD_CLEAN = """
+    import threading
+
+    def daemonized():
+        threading.Thread(target=print, daemon=True).start()
+
+    def joined():
+        ts = [threading.Thread(target=print) for _ in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+"""
+
+
+def test_thread_hygiene_flags_leaked_thread():
+    findings = _lint(THREAD_SEEDED, [ThreadHygienePass()])
+    assert _rules(findings) == {"thread-hygiene"}
+
+
+def test_thread_hygiene_clean_twin():
+    assert _lint(THREAD_CLEAN, [ThreadHygienePass()]) == []
+
+
+# ---- import-hygiene ----
+
+def _sources(files: dict) -> list:
+    return [
+        SourceFile(path, textwrap.dedent(text)) for path, text in files.items()
+    ]
+
+
+def test_import_hygiene_flags_transitive_jax():
+    srcs = _sources({
+        "pkg/__init__.py": "",
+        "pkg/control.py": "from pkg.helper import x\n",
+        "pkg/helper.py": "import jax\nx = 1\n",
+    })
+    p = ImportHygienePass(roots=("pkg.control",))
+    findings = run_passes(srcs, [p])
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "import-hygiene" and f.path == "pkg/control.py"
+    assert "pkg.helper" in f.message and f.line == 1
+
+
+def test_import_hygiene_deferred_import_is_clean():
+    srcs = _sources({
+        "pkg/__init__.py": "",
+        "pkg/control.py": "from pkg.helper import x\n",
+        "pkg/helper.py": "def f():\n    import jax\n    return jax\nx = 1\n",
+    })
+    findings = run_passes(srcs, [ImportHygienePass(roots=("pkg.control",))])
+    assert findings == []
+
+
+def test_import_hygiene_counts_package_init():
+    # Importing pkg.sub.mod executes pkg/__init__ and pkg/sub/__init__ —
+    # a jax import hiding in an ancestor package must be caught.
+    srcs = _sources({
+        "pkg/__init__.py": "",
+        "pkg/root.py": "from pkg.sub.mod import y\n",
+        "pkg/sub/__init__.py": "import jax\n",
+        "pkg/sub/mod.py": "y = 2\n",
+    })
+    findings = run_passes(srcs, [ImportHygienePass(roots=("pkg.root",))])
+    assert len(findings) == 1
+
+
+def test_import_hygiene_flags_module_level_platform_call():
+    # The real leak this pass closed: apply_platform_env() imports jax
+    # inside its body, so a module-level CALL executes the import even
+    # though no 'import jax' statement is visible at module scope.
+    srcs = _sources({
+        "pkg/__init__.py": "",
+        "pkg/control.py": (
+            "from elasticdl_tpu.common.platform import apply_platform_env\n"
+            "apply_platform_env()\n"
+        ),
+    })
+    findings = run_passes(srcs, [ImportHygienePass(roots=("pkg.control",))])
+    assert len(findings) == 1 and findings[0].line == 2
+
+
+def test_master_process_is_jax_free_at_runtime():
+    # The runtime twin of the static pass: importing the master stack in a
+    # fresh interpreter must not pull jax into the process.
+    code = (
+        "import sys; "
+        "import elasticdl_tpu.master.main, elasticdl_tpu.master.servicer, "
+        "elasticdl_tpu.master.pod_manager, elasticdl_tpu.common.platform; "
+        "sys.exit(1 if 'jax' in sys.modules else 0)"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO, timeout=120,
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+
+
+# ---- waivers ----
+
+def test_valid_waiver_suppresses_finding():
+    src = """
+        import time
+
+        class W:
+            # hot-path
+            def f(self):
+                # graftlint: allow[hot-path-sync] idle poll is the work here
+                time.sleep(0.1)
+    """
+    assert _lint(src, [HotPathSyncPass()]) == []
+
+
+def test_waiver_same_line_form():
+    src = """
+        import time
+
+        class W:
+            # hot-path
+            def f(self):
+                time.sleep(0.1)  # graftlint: allow[hot-path-sync] idle poll
+    """
+    assert _lint(src, [HotPathSyncPass()]) == []
+
+
+def test_waiver_wrong_rule_does_not_suppress():
+    src = """
+        import time
+
+        class W:
+            # hot-path
+            def f(self):
+                # graftlint: allow[thread-hygiene] reason for another rule
+                time.sleep(0.1)
+    """
+    findings = _lint(src, [HotPathSyncPass()])
+    assert _rules(findings) == {"hot-path-sync"}
+
+
+@pytest.mark.parametrize(
+    "waiver, expect",
+    [
+        ("# graftlint: allow[hot-path-sync]", "no reason"),
+        ("# graftlint: allow[] why not", "names no rule"),
+        ("# graftlint: allow hot-path-sync why", "malformed"),
+        ("# graftlint: allow[not-a-rule] why", "unknown rule"),
+    ],
+)
+def test_malformed_waivers_are_findings(waiver, expect):
+    src = f"""
+        def f():
+            {waiver}
+            pass
+    """
+    findings = _lint(src, [])
+    assert len(findings) == 1
+    assert findings[0].rule == "waiver-syntax"
+    assert expect in findings[0].message
+
+
+def test_malformed_waiver_cannot_waive_itself():
+    src = """
+        def f():
+            # graftlint: allow[waiver-syntax] trying to excuse myself
+            # graftlint: allow[]
+            pass
+    """
+    findings = _lint(src, [])
+    assert any("names no rule" in f.message for f in findings)
+
+
+def test_import_hygiene_module_level_loop_body_counts():
+    # A top-level loop body executes at import time too — it must not be
+    # a smuggling route.
+    srcs = _sources({
+        "pkg/__init__.py": "",
+        "pkg/control.py": "for _ in range(1):\n    import jax\n",
+    })
+    findings = run_passes(srcs, [ImportHygienePass(roots=("pkg.control",))])
+    assert len(findings) == 1
+
+
+# ---- parse errors and scoping ----
+
+def test_parse_error_has_its_own_rule(tmp_path):
+    (tmp_path / "broken.py").write_text("def f(:\n")
+    findings = run_lint([str(tmp_path)])
+    assert [f.rule for f in findings] == ["parse-error"]
+
+
+def test_only_paths_scopes_parse_errors_too(tmp_path):
+    (tmp_path / "broken.py").write_text("def f(:\n")
+    (tmp_path / "ok.py").write_text("x = 1\n")
+    findings = run_lint(
+        [str(tmp_path)], rel_to=str(tmp_path), only_paths={"ok.py"}
+    )
+    assert findings == []
+
+
+# ---- the repo-wide gate ----
+
+def test_repo_lints_clean():
+    findings = run_lint(
+        [os.path.join(REPO, "elasticdl_tpu"), os.path.join(REPO, "tools")],
+        rel_to=REPO,
+    )
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_cli_exits_zero_on_repo_and_one_on_violation(tmp_path):
+    out = subprocess.run(
+        [sys.executable, "tools/graftlint.py", "elasticdl_tpu", "tools"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import threading\n"
+        "threading.Thread(target=print).start()\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "tools/graftlint.py", str(bad)],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 1
+    assert "thread-hygiene" in out.stdout
+
+
+def test_cli_artifact_stamps_counts_and_code_rev(tmp_path):
+    art = tmp_path / "LINT_test.json"
+    out = subprocess.run(
+        [
+            sys.executable, "tools/graftlint.py", "elasticdl_tpu", "tools",
+            "--artifact", str(art),
+        ],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    rec = json.loads(art.read_text())
+    assert rec["findings"] == 0
+    assert rec["files_scanned"] > 50
+    assert "code_rev" in rec and "rules" in rec
+    assert "command" in rec  # write_artifact's shared stamp
+
+
+def test_cli_changed_fails_loud_when_git_unreadable():
+    # 'git broke' must never be reported as 'nothing changed, gate clean'.
+    out = subprocess.run(
+        [sys.executable, "tools/graftlint.py", "--changed"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+        env={**os.environ, "GIT_DIR": "/nonexistent"},
+    )
+    assert out.returncode == 2
+    assert "git" in out.stderr
+
+
+def test_cli_changed_mode_runs(tmp_path):
+    # --changed must run and exit cleanly whatever the current diff is;
+    # findings it reports are restricted to changed files.
+    out = subprocess.run(
+        [sys.executable, "tools/graftlint.py", "--changed", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode in (0, 1), out.stderr
+    json.loads(out.stdout)  # valid JSON either way

@@ -17,6 +17,40 @@ from typing import Callable, Optional
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def code_rev(repo: Optional[str] = None) -> str:
+    """Commit hash of the code producing an artifact (best-effort).
+
+    Stamped into bench/lint artifacts so trend consumers (and bench.py's
+    best-run-wins record guard) can tell "another run of the same code"
+    from "the first run of NEW code".  A dirty tree gets a "-dirty" suffix
+    — uncommitted changes are NEW code under the same HEAD, and two dirty
+    runs may differ from each other too, so dirty never matches anything.
+    Untracked files count as dirt: a new not-yet-added module is importable
+    code the committed rev does not describe (ignored files still don't
+    count).  Returns "" when git is unavailable.
+    """
+    try:
+        import subprocess
+
+        repo = repo or _REPO_ROOT
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=repo, capture_output=True, text=True, timeout=10,
+        )
+        if out.returncode != 0:
+            return ""
+        rev = out.stdout.strip()
+        st = subprocess.run(
+            ["git", "status", "--porcelain"],
+            cwd=repo, capture_output=True, text=True, timeout=10,
+        )
+        if st.returncode != 0 or st.stdout.strip():
+            rev += "-dirty"
+        return rev
+    except Exception:
+        return ""
+
+
 def write_artifact(
     result: dict,
     default_name: str,

@@ -41,10 +41,11 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 
 from elasticdl_tpu.common.platform import apply_platform_env, enable_compile_cache
 
-apply_platform_env()
-
-import jax  # noqa: E402
 import numpy as np  # noqa: E402
+
+# jax is imported inside main(): importing this module (lint/CLI paths)
+# must never pay a backend init — apply_platform_env itself imports jax
+# when JAX_PLATFORMS is set, so it is deferred too.
 
 
 def _batch(n=64, seed=0):
@@ -57,6 +58,9 @@ def _batch(n=64, seed=0):
 
 
 def main() -> None:
+    apply_platform_env()
+    import jax
+
     enable_compile_cache()
     from elasticdl_tpu.common.checkpoint import CheckpointManager
     from elasticdl_tpu.common.config import DistributionStrategy, JobConfig

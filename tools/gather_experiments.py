@@ -17,13 +17,12 @@ import os
 import sys
 import time
 
-from elasticdl_tpu.common.platform import apply_platform_env, enable_compile_cache
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-apply_platform_env()
-
-import jax
-import jax.numpy as jnp
-from jax import lax
+from elasticdl_tpu.common.platform import (  # noqa: E402
+    apply_platform_env,
+    enable_compile_cache,
+)
 
 B, F = 8192, 26
 BUCKETS = 65536
@@ -31,9 +30,30 @@ V = F * BUCKETS          # 1,703,936
 DIM = 8
 PACK = 128 // DIM        # 16 logical rows per 128-lane physical row
 
-_GATHER_DNUMS = lax.GatherDimensionNumbers(
-    offset_dims=(1,), collapsed_slice_dims=(), start_index_map=(0,)
-)
+# jax globals are populated by _init_jax(): importing this module must stay
+# cheap and chip-free — scatter_experiments imports it just for
+# trace_total_device_us, and --help/lint paths must never pay (or hang on)
+# a backend init.  Function bodies resolve these names at CALL time, so
+# everything below works unchanged once main() has run _init_jax().
+jax = None
+jnp = None
+lax = None
+_GATHER_DNUMS = None
+
+
+def _init_jax() -> None:
+    global jax, jnp, lax, _GATHER_DNUMS
+    if jax is not None:
+        return
+    apply_platform_env()
+    import jax as _jax
+    import jax.numpy as _jnp
+    from jax import lax as _lax
+
+    jax, jnp, lax = _jax, _jnp, _lax
+    _GATHER_DNUMS = lax.GatherDimensionNumbers(
+        offset_dims=(1,), collapsed_slice_dims=(), start_index_map=(0,)
+    )
 
 
 def flat_lookup(flat, ids):
@@ -81,7 +101,9 @@ def packed_lookup_width(packed, ids, width):
     return out.reshape(B, F, DIM)
 
 
-def _packed_table(key, width, dtype=jnp.float32):
+def _packed_table(key, width, dtype=None):
+    # dtype default resolved at call time (module import is jax-free).
+    dtype = jnp.float32 if dtype is None else dtype
     rows = V // (width // DIM)
     return jax.random.normal(key, (rows, width)).astype(dtype)
 
@@ -135,6 +157,7 @@ def main():
     ap.add_argument("--steps", type=int, default=5)
     ap.add_argument("--outbase", default="/tmp/gexp")
     args = ap.parse_args()
+    _init_jax()
     enable_compile_cache()
     print(f"devices: {jax.devices()}", file=sys.stderr)
 

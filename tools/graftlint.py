@@ -1,0 +1,170 @@
+"""graftlint CLI — the repo's static-analysis gate.
+
+Usage:
+    python tools/graftlint.py [paths...]         # default: elasticdl_tpu tools
+    python tools/graftlint.py --changed          # git-diff-scoped fast mode
+    python tools/graftlint.py --json             # machine-readable findings
+    python tools/graftlint.py --artifact [PATH]  # stamp LINT artifact
+    python tools/graftlint.py --list-rules
+
+Exit code 0 = clean, 1 = findings, 2 = usage/internal error.  Pure stdlib
+and jax-free by design (the import-hygiene pass guards this file too): the
+pre-commit path must cost milliseconds, never a backend init.
+
+Waiver syntax (inline, same line as the finding or the comment-only line
+above): ``# graftlint: allow[<rule>] <reason>`` — reason mandatory; see
+docs/static_analysis.md for the invariant catalogue.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+from collections import Counter
+from typing import List, Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+DEFAULT_PATHS = ("elasticdl_tpu", "tools")
+
+
+def _changed_files(repo: str) -> Optional[List[str]]:
+    """Repo-relative .py files touched vs HEAD (worktree + index) plus
+    untracked — the pre-commit scope.  None when git itself failed: the
+    caller must fail LOUD (exit 2), because 'git broke' reported as
+    'nothing changed' would let a violating commit through the gate."""
+    out: List[str] = []
+    for args in (
+        ["git", "diff", "--name-only", "HEAD", "--"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            r = subprocess.run(
+                args, cwd=repo, capture_output=True, text=True, timeout=20
+            )
+        except Exception:
+            return None
+        if r.returncode != 0:
+            return None
+        out.extend(line.strip() for line in r.stdout.splitlines())
+    return sorted({p for p in out if p.endswith(".py")})
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="graftlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=list(DEFAULT_PATHS),
+        help="files/directories to lint (default: elasticdl_tpu tools)",
+    )
+    parser.add_argument(
+        "--changed", action="store_true",
+        help="lint only files changed vs HEAD (plus untracked) under the "
+        "given paths — pre-commit fast mode; project-wide passes still "
+        "see the full file set",
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit findings as a JSON array",
+    )
+    parser.add_argument(
+        "--artifact", nargs="?", const="", default=None, metavar="PATH",
+        help="write a LINT artifact (findings count + per-rule counts + "
+        "code_rev) via tools/artifact.py; optional explicit path, else "
+        "artifacts/LINT_r07.json (env override LINT_OUT)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="list rules and exit"
+    )
+    args = parser.parse_args(argv)
+
+    from elasticdl_tpu.analysis import all_passes
+    from elasticdl_tpu.analysis.core import iter_file_paths, run_lint
+
+    passes = all_passes()
+    if args.list_rules:
+        for p in passes:
+            print(f"{p.name:18s} {p.description}")
+        print(f"{'waiver-syntax':18s} waivers must be "
+              "'# graftlint: allow[<rule>] <reason>' with a known rule")
+        return 0
+
+    # Resolve paths relative to the repo root so display paths (and the
+    # import-hygiene module names derived from them) are stable no matter
+    # where the tool is invoked from.
+    roots = [
+        p if os.path.isabs(p) else os.path.join(_REPO_ROOT, p)
+        for p in args.paths
+    ]
+    missing = [p for p in roots if not os.path.exists(p)]
+    if missing:
+        print(f"graftlint: no such path: {', '.join(missing)}", file=sys.stderr)
+        return 2
+
+    all_files = iter_file_paths(roots)
+    only_paths = None
+    if args.changed:
+        changed = _changed_files(_REPO_ROOT)
+        if changed is None:
+            print(
+                "graftlint: --changed could not read the git state; "
+                "refusing to report a clean pass (run without --changed)",
+                file=sys.stderr,
+            )
+            return 2
+        changed_set = set(changed)
+        only_paths = {
+            os.path.relpath(fp, _REPO_ROOT)
+            for fp in all_files
+            if os.path.relpath(fp, _REPO_ROOT) in changed_set
+        }
+    findings = run_lint(
+        roots, passes, rel_to=_REPO_ROOT, only_paths=only_paths
+    )
+
+    if args.as_json:
+        print(json.dumps(
+            [f.__dict__ for f in findings], indent=1, sort_keys=True
+        ))
+    else:
+        for f in findings:
+            print(f.render())
+        scope = (
+            f"{len(only_paths)} changed" if only_paths is not None
+            else str(len(all_files))
+        )
+        print(
+            f"graftlint: {len(findings)} finding(s) across {scope} file(s)",
+            file=sys.stderr,
+        )
+
+    if args.artifact is not None:
+        from tools.artifact import code_rev, write_artifact
+
+        by_rule = Counter(f.rule for f in findings)
+        write_artifact(
+            {
+                "findings": len(findings),
+                "by_rule": dict(sorted(by_rule.items())),
+                "files_scanned": len(all_files),
+                "changed_only": bool(args.changed),
+                "rules": sorted(p.name for p in passes),
+                "code_rev": code_rev(),
+            },
+            "LINT_r07.json",
+            env_var="LINT_OUT",
+            path=args.artifact or None,
+        )
+
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,14 +23,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from elasticdl_tpu.common.platform import apply_platform_env, enable_compile_cache
 
-apply_platform_env()
-
-import jax  # noqa: E402
+# jax imports live inside the functions that profile: --parse-only and
+# --help must never touch (or hang on) the chip.
 
 
 def run_profiled_steps(
     out_dir: str, steps: int, batch_size: int, impl: str, config: str = ""
 ):
+    apply_platform_env()
+    import jax
     import jax.numpy as jnp
 
     from elasticdl_tpu.common.config import DistributionStrategy, JobConfig
@@ -93,6 +94,8 @@ def run_profiled_steps(
 
 def _profile_loop(trainer, batch, out_dir: str, steps: int):
     import time
+
+    import jax
 
     state = trainer.init_state(jax.random.key(0))
     t0 = time.perf_counter()

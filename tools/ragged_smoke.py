@@ -14,26 +14,28 @@ Usage: python tools/ragged_smoke.py   (needs the TPU; do not run concurrently
 with other chip users)
 """
 
-from elasticdl_tpu.common.platform import apply_platform_env
-
-apply_platform_env()
-
-import jax  # noqa: E402
-import jax.numpy as jnp  # noqa: E402
-import numpy as np  # noqa: E402
-from jax.sharding import NamedSharding  # noqa: E402
-from jax.sharding import PartitionSpec as P  # noqa: E402
-
-from elasticdl_tpu.common.jax_compat import shard_map  # noqa: E402
-from elasticdl_tpu.ops.embedding import (  # noqa: E402
-    ParallelContext,
-    embedding_lookup,
-    pack_table,
-)
-from elasticdl_tpu.parallel.mesh import create_mesh  # noqa: E402
-
-
 def main() -> None:
+    # Heavy imports deferred to here: importing this module (lint/CLI
+    # paths) must never touch — or hang on — the chip; apply_platform_env
+    # still runs before the first framework jax use.
+    from elasticdl_tpu.common.platform import apply_platform_env
+
+    apply_platform_env()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from elasticdl_tpu.common.jax_compat import shard_map
+    from elasticdl_tpu.ops.embedding import (
+        ParallelContext,
+        embedding_lookup,
+        pack_table,
+    )
+    from elasticdl_tpu.parallel.mesh import create_mesh
+
     devices = jax.devices()
     assert devices[0].platform == "tpu", f"needs TPU, got {devices}"
     mesh = create_mesh(devices)

@@ -26,15 +26,32 @@ import os
 import sys
 import time
 
-from elasticdl_tpu.common.platform import apply_platform_env, enable_compile_cache
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-apply_platform_env()
+from elasticdl_tpu.common.platform import (  # noqa: E402
+    apply_platform_env,
+    enable_compile_cache,
+)
+from tools.gather_experiments import trace_total_device_us  # noqa: E402
 
-import jax
-import jax.numpy as jnp
-from jax import lax
+# jax globals populated by _init_jax() (same lazy pattern as
+# gather_experiments): module import stays cheap and chip-free for
+# --help/lint paths; function bodies resolve the names at call time.
+jax = None
+jnp = None
+lax = None
 
-from tools.gather_experiments import trace_total_device_us
+
+def _init_jax() -> None:
+    global jax, jnp, lax
+    if jax is not None:
+        return
+    apply_platform_env()
+    import jax as _jax
+    import jax.numpy as _jnp
+    from jax import lax as _lax
+
+    jax, jnp, lax = _jax, _jnp, _lax
 
 B, F = 8192, 26
 N = B * F                 # 212,992 touched rows per step
@@ -133,6 +150,7 @@ def main():
     ap.add_argument("--steps", type=int, default=8)
     ap.add_argument("--outbase", default="/tmp/sexp")
     args = ap.parse_args()
+    _init_jax()
     enable_compile_cache()
     print(f"devices: {jax.devices()}", file=sys.stderr)
 
