@@ -19,23 +19,50 @@ gated, not reviewed, into compliance:
 - ``import-hygiene``    master/bench-process modules stay jax-free at
                         import time (transitive)
 
+v2 adds the interprocedural layer (``analysis/callgraph.py``: resolved
+self-method and module-function call edges across the repo):
+
+- ``blocking-propagation``  a ``# hot-path`` function may not reach a
+                            blocking call through its CALLEE CHAIN outside
+                            a ``phases.phase(...)`` boundary — the helper
+                            wrapping ``block_until_ready`` that
+                            ``hot-path-sync`` cannot see
+- ``lock-order``            the lock acquisition graph (which locks are
+                            held when another is acquired, propagated
+                            across call edges) must be acyclic and honor
+                            ``# lock-order: leaf`` / ``before(...)``
+                            declarations; locksan-wrapped locks must agree
+                            with their static annotation
+- ``stale-waiver``          a waiver that suppresses no finding is itself
+                            a finding (the inventory cannot rot)
+
+The runtime twin of ``lock-order`` is ``common/locksan.py``: a debug lock
+wrapper that records actual acquisition orders under ``GRAFT_LOCKSAN=1``
+(on for tier-1 via tests/conftest.py) and raises on inversions or
+leaf-order violations — the static model and the runtime behavior gate
+each other.
+
 Inline waivers: ``# graftlint: allow[<rule>] <reason>`` — the reason is
 mandatory; malformed waivers are themselves findings (``waiver-syntax``).
 CLI driver: ``python tools/graftlint.py [paths...]``.  Pure stdlib — the
 linter must never pay (or hang on) a jax import.
 """
 
+from elasticdl_tpu.analysis.blocking import BlockingPropagationPass
 from elasticdl_tpu.analysis.compat_shim import CompatShimPass
 from elasticdl_tpu.analysis.core import (  # noqa: F401
     Finding,
     LintPass,
     SourceFile,
+    collect_waivers,
     lint_text,
     run_lint,
+    run_lint_full,
 )
 from elasticdl_tpu.analysis.hot_path import HotPathSyncPass
 from elasticdl_tpu.analysis.import_hygiene import ImportHygienePass
 from elasticdl_tpu.analysis.lock_discipline import LockDisciplinePass
+from elasticdl_tpu.analysis.lock_order import LockOrderPass
 from elasticdl_tpu.analysis.rpc_discipline import RpcDisciplinePass
 from elasticdl_tpu.analysis.thread_hygiene import ThreadHygienePass
 
@@ -46,8 +73,10 @@ def all_passes() -> list:
     return [
         LockDisciplinePass(),
         HotPathSyncPass(),
+        BlockingPropagationPass(),
         CompatShimPass(),
         RpcDisciplinePass(),
         ThreadHygienePass(),
         ImportHygienePass(),
+        LockOrderPass(),
     ]

@@ -1,0 +1,61 @@
+"""blocking-propagation: interprocedural hot-path blocking detection.
+
+r7's ``hot-path-sync`` is per-function: a ``# hot-path`` function calling a
+one-line helper that wraps ``block_until_ready`` passed clean, because the
+primitive sits in the helper's body and the helper carries no marker.  This
+pass closes that hole with the call graph (analysis/callgraph.py):
+
+1. compute, for every function, whether its *callee chain* may block at
+   steady state — a blocking primitive outside a ``phases.phase(...)``
+   boundary / ``except`` handler that carries no ``hot-path-sync`` waiver,
+   or a non-exempt call to a function that does;
+2. flag every non-exempt call site in a ``# hot-path`` function whose
+   callee may block, with the full witness chain down to the primitive.
+
+Direct primitives in the hot function itself stay ``hot-path-sync``'s
+findings (one rule per failure shape); this pass only reports the edges
+the r7 pass is blind to.  A waived primitive does not propagate: the
+waiver's reason covers the call no matter how deep the caller sits.
+
+Waive with ``# graftlint: allow[blocking-propagation] <reason>`` on the
+flagged call site.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Sequence
+
+from elasticdl_tpu.analysis.callgraph import shared_graph
+from elasticdl_tpu.analysis.core import Finding, LintPass, SourceFile
+
+
+class BlockingPropagationPass(LintPass):
+    name = "blocking-propagation"
+    description = (
+        "'# hot-path' functions may not reach a blocking call through their "
+        "callee chain outside a phases.phase(...) boundary"
+    )
+
+    def run_project(self, files: Sequence[SourceFile]) -> Iterable[Finding]:
+        graph = shared_graph(files)
+        witnesses = graph.blocking_witnesses()
+        findings: List[Finding] = []
+        for fn in graph.functions.values():
+            if not fn.hot_path:
+                continue
+            for call in fn.calls:
+                if call.exempt:
+                    continue
+                chain = witnesses.get(call.callee)
+                if chain is None:
+                    continue
+                callee_name = call.callee.split(":")[-1]
+                findings.append(Finding(
+                    self.name, fn.path, call.line,
+                    f"hot-path {fn.qualname.split(':')[-1]} calls "
+                    f"{callee_name}, whose callee chain blocks: "
+                    + " -> ".join(chain)
+                    + " — move the call behind a phases.phase(...) "
+                    "boundary, off the hot path, or waive with a reason",
+                ))
+        return findings

@@ -35,6 +35,12 @@ KNOWN_RULES = {
     "rpc-discipline",
     "thread-hygiene",
     "import-hygiene",
+    # v2 interprocedural passes (analysis/callgraph.py layer).
+    "blocking-propagation",
+    "lock-order",
+    # A waiver that suppresses no finding is itself a finding: the waiver
+    # inventory must not rot as code moves (see run_passes).
+    "stale-waiver",
     "waiver-syntax",
     # Unreadable / syntactically invalid files: not waivable (a broken file
     # cannot carry a trustworthy waiver), but a distinct rule id so the
@@ -93,6 +99,10 @@ class SourceFile:
         self._scan_comments()
         self.waivers: Dict[int, Waiver] = {}
         self.waiver_errors: List[Finding] = []
+        #: Waiver lines that suppressed at least one finding this run —
+        #: populated by ``waived()``; the runner turns the complement into
+        #: ``stale-waiver`` findings.
+        self.used_waiver_lines: set = set()
         self._parse_waivers()
 
     def _scan_comments(self) -> None:
@@ -184,6 +194,7 @@ class SourceFile:
             if cand == finding.line - 1 and cand not in self.comment_only_lines:
                 continue
             if w.rule == finding.rule:
+                self.used_waiver_lines.add(cand)
                 return True
         return False
 
@@ -291,7 +302,79 @@ def run_passes(
             if only_paths is not None and f.path not in only_paths:
                 continue
             findings.append(f)
+    # Stale waivers: a waiver that suppressed nothing is itself a finding —
+    # the inventory must shrink as code moves, not fossilize.  Only judged
+    # for rules that actually RAN (a subset lint cannot know whether the
+    # waiver is live) — except waiver-syntax, which is never waivable, so
+    # a waiver naming it is stale by construction.  allow[stale-waiver]
+    # waivers are exempt from staleness (they exist to waive THIS rule's
+    # findings; recursing would make them un-waivable).
+    active_rules = {p.name for p in passes} | {"waiver-syntax"}
+    for src in sources:
+        if only_paths is not None and src.path not in only_paths:
+            continue
+        for line, w in sorted(src.waivers.items()):
+            if w.rule == "stale-waiver" or w.rule not in active_rules:
+                continue
+            if line in src.used_waiver_lines:
+                continue
+            f = Finding(
+                "stale-waiver", src.path, line,
+                f"waiver for {w.rule!r} suppresses no finding — the code "
+                "it excused moved or was fixed; delete the waiver",
+            )
+            if not src.waived(f):
+                findings.append(f)
     return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def collect_waivers(
+    sources: Sequence[SourceFile], only_paths: Optional[set] = None
+) -> List[dict]:
+    """The waiver inventory (file, line, rule, reason) — stamped into the
+    LINT artifact and ``--json`` output so waiver count per rule is
+    trackable across rounds."""
+    out: List[dict] = []
+    for src in sources:
+        if only_paths is not None and src.path not in only_paths:
+            continue
+        for line, w in sorted(src.waivers.items()):
+            out.append({
+                "path": src.path, "line": line,
+                "rule": w.rule, "reason": w.reason,
+            })
+    return out
+
+
+def run_lint_full(
+    paths: Sequence[str],
+    passes: Optional[Sequence[LintPass]] = None,
+    rel_to: Optional[str] = None,
+    only_paths: Optional[set] = None,
+    preloaded: Optional[tuple] = None,
+) -> tuple:
+    """Lint ``paths``; returns ``(findings, sources)`` so callers (CLI
+    waiver inventory, --callgraph stats) reuse the parsed files.
+    ``preloaded`` is an already-computed ``load_sources`` result for the
+    same paths (the --changed dependent scan parses first; re-reading 80+
+    files would double the pre-commit cost)."""
+    if passes is None:
+        from elasticdl_tpu.analysis import all_passes
+
+        passes = all_passes()
+    if preloaded is not None:
+        sources, errors = preloaded
+    else:
+        sources, errors = load_sources(iter_file_paths(paths), rel_to=rel_to)
+    if only_paths is not None:
+        # Changed-only mode scopes REPORTING, parse errors included — an
+        # out-of-scope broken file must not fail a scoped run.
+        errors = [f for f in errors if f.path in only_paths]
+    findings = sorted(
+        errors + run_passes(sources, passes, only_paths=only_paths),
+        key=lambda f: (f.path, f.line, f.rule),
+    )
+    return findings, sources
 
 
 def run_lint(
@@ -301,19 +384,9 @@ def run_lint(
     only_paths: Optional[set] = None,
 ) -> List[Finding]:
     """Lint ``paths`` with ``passes`` (default: the full suite)."""
-    if passes is None:
-        from elasticdl_tpu.analysis import all_passes
-
-        passes = all_passes()
-    sources, errors = load_sources(iter_file_paths(paths), rel_to=rel_to)
-    if only_paths is not None:
-        # Changed-only mode scopes REPORTING, parse errors included — an
-        # out-of-scope broken file must not fail a scoped run.
-        errors = [f for f in errors if f.path in only_paths]
-    return sorted(
-        errors + run_passes(sources, passes, only_paths=only_paths),
-        key=lambda f: (f.path, f.line, f.rule),
-    )
+    return run_lint_full(
+        paths, passes, rel_to=rel_to, only_paths=only_paths
+    )[0]
 
 
 def lint_text(

@@ -31,7 +31,7 @@ Designated boundaries are exempt, matching the runtime convention:
 from __future__ import annotations
 
 import ast
-from typing import Iterable, List
+from typing import Iterable, List, Optional
 
 from elasticdl_tpu.analysis.core import Finding, LintPass, SourceFile, attr_chain
 
@@ -55,13 +55,49 @@ def _references_state(node: ast.AST) -> bool:
     return False
 
 
-def _is_phase_context(ctx: ast.expr) -> bool:
+def is_phase_context(ctx: ast.expr) -> bool:
     """``with self.phases.phase("x"):``-shaped context expression."""
     return (
         isinstance(ctx, ast.Call)
         and isinstance(ctx.func, ast.Attribute)
         and ctx.func.attr == "phase"
     )
+
+
+_is_phase_context = is_phase_context  # r7 name, kept for callers
+
+
+def blocking_reason(node: ast.Call) -> Optional[str]:
+    """Why this call blocks the hot path, or None.  Shared detector: the
+    per-function pass below flags these directly; the interprocedural
+    blocking-propagation pass (analysis/blocking.py) uses the same
+    predicate to decide which functions "may block" transitively."""
+    f = node.func
+    chain = attr_chain(f)
+    if isinstance(f, ast.Attribute):
+        if f.attr == "block_until_ready" or chain == "jax.block_until_ready":
+            return "block_until_ready drains the dispatch pipeline"
+        if f.attr == "item" and not node.args and not node.keywords:
+            return ".item() is a blocking device->host scalar read"
+        if chain == "jax.device_get":
+            return "jax.device_get blocks on transfer"
+        if chain == "time.sleep":
+            return "time.sleep stalls the hot path"
+        if f.attr in ("call", "call_async") and chain:
+            recv = chain.rsplit(".", 1)[0].split(".")[-1]
+            if recv == "master":
+                return "blocking master RPC on the hot path"
+        if chain in _ASARRAY_CHAINS and any(
+            _references_state(a) for a in node.args
+        ):
+            return f"{chain} over self.state forces a device->host copy"
+    elif isinstance(f, ast.Name) and f.id in _CAST_CALLEES:
+        if any(_references_state(a) for a in node.args):
+            return (
+                f"{f.id}() over self.state is a blocking device read "
+                "(use the python-side step mirror)"
+            )
+    return None
 
 
 class HotPathSyncPass(LintPass):
@@ -101,34 +137,7 @@ class HotPathSyncPass(LintPass):
             self._visit(src, child, findings)
 
     def _check_call(self, src, node: ast.Call, findings) -> None:
-        f = node.func
-        chain = attr_chain(f)
-        msg = None
-        if isinstance(f, ast.Attribute):
-            if f.attr == "block_until_ready" or chain == "jax.block_until_ready":
-                msg = "block_until_ready drains the dispatch pipeline"
-            elif f.attr == "item" and not node.args and not node.keywords:
-                msg = ".item() is a blocking device->host scalar read"
-            elif chain == "jax.device_get":
-                msg = "jax.device_get blocks on transfer"
-            elif chain == "time.sleep":
-                msg = "time.sleep stalls the hot path"
-            elif f.attr in ("call", "call_async") and chain:
-                recv = chain.rsplit(".", 1)[0].split(".")[-1]
-                if recv == "master":
-                    msg = "blocking master RPC on the hot path"
-            elif chain in _ASARRAY_CHAINS and any(
-                _references_state(a) for a in node.args
-            ):
-                msg = (
-                    f"{chain} over self.state forces a device->host copy"
-                )
-        elif isinstance(f, ast.Name) and f.id in _CAST_CALLEES:
-            if any(_references_state(a) for a in node.args):
-                msg = (
-                    f"{f.id}() over self.state is a blocking device read "
-                    "(use the python-side step mirror)"
-                )
+        msg = blocking_reason(node)
         if msg is not None:
             findings.append(Finding(
                 self.name, src.path, node.lineno,

@@ -130,6 +130,43 @@ def _top_level_imports(tree: ast.Module) -> List[Tuple[str, int]]:
     return out
 
 
+def module_dependents(
+    files: Sequence[SourceFile], changed_paths: set
+) -> set:
+    """Display paths of modules that (transitively) import any CHANGED
+    module at module level — the re-lint scope ``--changed`` adds so the
+    project-wide passes (import-hygiene, lock-order) judge every root a
+    change can affect, not just the changed files themselves.  Importing
+    ``a.b.c`` executes ``a`` and ``a.b`` too, so a changed package
+    ``__init__`` pulls in every importer underneath it."""
+    mod_path: Dict[str, str] = {}
+    for src in files:
+        name = _module_name(src.path)
+        if name is not None:
+            mod_path[name] = src.path
+    rev: Dict[str, set] = {}
+    for src in files:
+        name = _module_name(src.path)
+        if name is None:
+            continue
+        for target, _line in _top_level_imports(src.tree):
+            parts = target.split(".")
+            for i in range(1, len(parts) + 1):
+                cand = ".".join(parts[:i])
+                if cand in mod_path:
+                    rev.setdefault(cand, set()).add(name)
+    changed_mods = [m for m, p in mod_path.items() if p in changed_paths]
+    seen = set(changed_mods)
+    queue = list(changed_mods)
+    while queue:
+        cur = queue.pop()
+        for dep in rev.get(cur, ()):
+            if dep not in seen:
+                seen.add(dep)
+                queue.append(dep)
+    return {mod_path[m] for m in seen}
+
+
 class ImportHygienePass(LintPass):
     name = "import-hygiene"
     description = (

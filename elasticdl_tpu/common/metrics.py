@@ -17,6 +17,7 @@ import threading
 import time
 from typing import Dict, Optional
 
+from elasticdl_tpu.common import locksan
 from elasticdl_tpu.common.log_utils import get_logger
 
 logger = get_logger("metrics")
@@ -111,7 +112,7 @@ class PhaseTimers:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locksan.lock("PhaseTimers._lock", leaf=True)  # lock-order: leaf
         self._seconds: Dict[str, float] = {}
         self._counts: Dict[str, int] = {}
         self._local = threading.local()
@@ -172,7 +173,7 @@ class MetricsWriter:
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self._path = os.path.join(self.directory, "metrics.jsonl")
-        self._lock = threading.Lock()
+        self._lock = locksan.lock("MetricsWriter._lock", leaf=True)  # lock-order: leaf
         self._tb = None
         if tensorboard:
             try:

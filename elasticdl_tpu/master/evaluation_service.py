@@ -11,9 +11,9 @@ batch sizes weight correctly.
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, List, Optional
 
+from elasticdl_tpu.common import locksan
 from elasticdl_tpu.data.reader import Shard
 from elasticdl_tpu.master.task_dispatcher import (
     TASK_EVALUATION,
@@ -32,7 +32,9 @@ class EvaluationService:
         self._shards = list(eval_shards)
         self._every = evaluation_steps
         self._task_timeout_s = task_timeout_s
-        self._lock = threading.Lock()
+        # Held while consulting the round dispatcher's finished()/counts —
+        # so it orders before TaskDispatcher._lock, never after.
+        self._lock = locksan.lock("EvaluationService._lock")
         self._dispatcher: Optional[TaskDispatcher] = None
         self._last_triggered_version = 0
         self._sums: Dict[str, float] = {}

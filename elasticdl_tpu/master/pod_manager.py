@@ -37,6 +37,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from elasticdl_tpu.common import locksan
 from elasticdl_tpu.common.config import JobConfig
 from elasticdl_tpu.common.log_utils import get_logger
 
@@ -102,7 +103,7 @@ class FakePodBackend(PodBackend):
         self.pods: Dict[str, str] = {}  # name -> phase; guarded-by: _lock
         self.start_log: List[str] = []  # guarded-by: _lock
         self._auto_run = auto_run
-        self._lock = threading.Lock()
+        self._lock = locksan.lock("FakePodBackend._lock", leaf=True)  # lock-order: leaf
 
     def start_pod(self, name: str, env: Dict[str, str]) -> None:
         with self._lock:
@@ -163,7 +164,7 @@ class ProcessPodBackend(PodBackend):
     ):
         self._argv = argv or [sys.executable, "-m", "elasticdl_tpu.worker.main"]
         self._procs: Dict[str, subprocess.Popen] = {}  # guarded-by: _lock
-        self._lock = threading.Lock()
+        self._lock = locksan.lock("ProcessPodBackend._lock", leaf=True)  # lock-order: leaf
         self._poll = poll_interval_s
         self._inherit = inherit_env
         self._stop = threading.Event()
@@ -651,7 +652,7 @@ class PodManager:
         self._config = config
         self._env = dict(worker_env or {})
         self._prefix = name_prefix or f"{config.job_name}-worker"
-        self._lock = threading.Lock()
+        self._lock = locksan.lock("PodManager._lock", leaf=True)  # lock-order: leaf
         self._slots: Dict[int, Optional[PodInfo]] = {}  # guarded-by: _lock
         self._by_name: Dict[str, PodInfo] = {}  # guarded-by: _lock
         # Per-slot launch generation, NEVER reset (survives scale-down/up

@@ -14,9 +14,10 @@ coordination.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Callable, Dict, List, Optional
+
+from elasticdl_tpu.common import locksan
 
 
 class RendezvousServer:
@@ -25,7 +26,9 @@ class RendezvousServer:
         heartbeat_timeout_s: float = 30.0,
         clock: Callable[[], float] = time.monotonic,
     ):
-        self._lock = threading.Lock()
+        # Listeners fire OUTSIDE this lock (see _notify) precisely so no
+        # other lock is ever acquired under it.
+        self._lock = locksan.lock("RendezvousServer._lock", leaf=True)  # lock-order: leaf
         self._workers: Dict[str, float] = {}  # worker_id -> last heartbeat
         # worker_id -> advertised host (multi-host: seeds the rank-0
         # jax.distributed coordinator; empty for single-host workers)

@@ -32,6 +32,7 @@ from typing import Dict, Optional
 
 import grpc
 
+from elasticdl_tpu.common import locksan
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.rpc import (
     MASTER_SCHEMAS,
@@ -80,7 +81,7 @@ class MasterServicer:
         if self._epoch_end_eval:
             dispatcher.set_epoch_end_callback(self._on_epoch_end)
         self._written_eval_rounds = 0  # guarded-by: _lock
-        self._lock = threading.Lock()
+        self._lock = locksan.lock("MasterServicer._lock")
         self._model_version = 0  # guarded-by: _lock
         self._checkpoint: Dict[str, object] = {"path": None, "step": 0}  # guarded-by: _lock
         # Latest per-worker task-loop phase decomposition (cumulative
@@ -113,7 +114,9 @@ class MasterServicer:
         # ordinary GetTask logic by whichever process asks first, attributed
         # to a per-membership-version pseudo worker so a world change
         # requeues the group's in-flight tasks.
-        self._group_lock = threading.Lock()
+        # GetGroupTask materializes entries through GetTask while holding
+        # this lock, so it orders strictly before the state lock.
+        self._group_lock = locksan.lock("MasterServicer._group_lock", before=("_lock",))  # lock-order: before(_lock)
         self._group_version: Optional[int] = None  # guarded-by: _group_lock
         self._group_log: list = []  # guarded-by: _group_lock
 

@@ -18,6 +18,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
+from elasticdl_tpu.common import locksan
 from elasticdl_tpu.data.reader import Shard
 
 TASK_TRAINING = "training"
@@ -79,7 +80,9 @@ class TaskDispatcher:
         self._max_retries = max_task_retries
         self._clock = clock
 
-        self._lock = threading.Lock()
+        # Callbacks (_fire_epoch_end) and callers' locks stay outside this
+        # one by design; nothing is ever acquired under it.
+        self._lock = locksan.lock("TaskDispatcher._lock", leaf=True)  # lock-order: leaf
         self._todo: deque = deque()
         self._doing: Dict[int, _Doing] = {}
         self._done_count = 0

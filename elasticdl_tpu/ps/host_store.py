@@ -10,11 +10,11 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
 from typing import Optional
 
 import numpy as np
 
+from elasticdl_tpu.common import locksan
 from elasticdl_tpu.common.log_utils import get_logger
 
 logger = get_logger("ps.host_store")
@@ -23,7 +23,7 @@ _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libedl_native.so")
 _OPTIMIZERS = {"sgd": 0, "momentum": 1, "adagrad": 2, "adam": 3}
 
-_lib_lock = threading.Lock()
+_lib_lock = locksan.lock("_lib_lock", leaf=True)  # lock-order: leaf
 _lib: Optional[ctypes.CDLL] = None
 _lib_error: Optional[str] = None
 

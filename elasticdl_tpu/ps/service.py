@@ -58,6 +58,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import grpc
 import numpy as np
 
+from elasticdl_tpu.common import locksan
 from elasticdl_tpu.common.log_utils import get_logger
 
 logger = get_logger("ps.service")
@@ -272,7 +273,7 @@ class PSServer:
         # shard-divergent restore silently mixes model versions).  Written
         # by a Load handler thread, read by concurrent Stats handlers — a
         # leaf lock makes the hand-off explicit (graftlint lock-discipline).
-        self._meta_lock = threading.Lock()
+        self._meta_lock = locksan.lock("PSServer._meta_lock", leaf=True)  # lock-order: leaf
         self.restored_step: Optional[int] = None  # guarded-by: _meta_lock
         # Message-size limits must cover production batches: a full 8192x26
         # dim-8 push is ~8.5 MB of frame, over gRPC's 4 MB default — the

@@ -23,6 +23,7 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence
 import jax
 import numpy as np
 
+from elasticdl_tpu.common import locksan
 from elasticdl_tpu.common.checkpoint import CheckpointManager
 from elasticdl_tpu.common.config import JobConfig
 from elasticdl_tpu.common.log_utils import get_logger
@@ -156,7 +157,7 @@ class Worker:
         # the task loop, the background save thread (failure rollback), and
         # the preemption thread.  The leaf lock makes the hand-off explicit
         # (graftlint lock-discipline); nothing blocking ever runs under it.
-        self._ckpt_lock = threading.Lock()
+        self._ckpt_lock = locksan.lock("Worker._ckpt_lock", leaf=True)  # lock-order: leaf
         self._last_ckpt_step = 0  # guarded-by: _ckpt_lock
         self.reforms = 0  # elastic mesh re-formations (observability/tests)
         self._training_tasks_done = 0  # gates the one-task profiler trace
@@ -1359,6 +1360,7 @@ class Worker:
                     "proto": PROTOCOL_VERSION,
                 },
             )
+        # graftlint: allow[blocking-propagation] one-time initial membership application before the loop starts
         self._apply_membership(membership, initial=True)
         if self.state is None:
             self.state = self.trainer.init_state(jax.random.key(0))
@@ -1430,6 +1432,7 @@ class Worker:
                 self._parked = True
                 # Give an undispatched prepped task straight back to the
                 # master (it must not start device work now), then park.
+                # graftlint: allow[blocking-propagation] parked for preemption: the abandon report is the last useful work
                 self._abandon_prep()
                 # graftlint: allow[hot-path-sync] parked for preemption: the loop must only idle here
                 time.sleep(self._poll)
@@ -1565,6 +1568,7 @@ class Worker:
                     # must not interleave behind this round's eval
                     # aggregation, and the eval scores the settled state.
                     self._drain_prep()
+                    # graftlint: allow[blocking-propagation] eval settles synchronously by design: it scores a settled state
                     metrics, weight = self._run_evaluation_task(task)
                     report["metrics"] = metrics
                     report["weight"] = weight
@@ -1583,6 +1587,7 @@ class Worker:
                 logger.exception("task %d failed", task.task_id)
                 report["success"] = False
             if self._group_mode and not report["success"]:
+                # graftlint: allow[blocking-propagation] failure exit protocol: the member is leaving the world
                 self._group_resync(report, "synchronous task")  # raises
             if not self._group_mode or self._rank == 0:
                 # In lockstep mode every process ran the task's collectives,

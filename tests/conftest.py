@@ -18,6 +18,14 @@ os.environ["XLA_FLAGS"] = (
 )
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# Runtime lock-order sanitizer (common/locksan.py) ON for the whole tier-1
+# suite: every threaded path (worker task loop, servicer gRPC pool, PS
+# handlers, pod-manager watchers — and their subprocess workers, which
+# inherit the env) runs with acquisition-order assertions against the
+# static '# lock-order:' declarations graftlint checks.  setdefault so a
+# developer can force it off with GRAFT_LOCKSAN=0.
+os.environ.setdefault("GRAFT_LOCKSAN", "1")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

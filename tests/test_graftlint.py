@@ -11,11 +11,13 @@ import textwrap
 import pytest
 
 from elasticdl_tpu.analysis import all_passes
+from elasticdl_tpu.analysis.blocking import BlockingPropagationPass
 from elasticdl_tpu.analysis.compat_shim import CompatShimPass
 from elasticdl_tpu.analysis.core import SourceFile, lint_text, run_lint, run_passes
 from elasticdl_tpu.analysis.hot_path import HotPathSyncPass
-from elasticdl_tpu.analysis.import_hygiene import ImportHygienePass
+from elasticdl_tpu.analysis.import_hygiene import ImportHygienePass, module_dependents
 from elasticdl_tpu.analysis.lock_discipline import LockDisciplinePass
+from elasticdl_tpu.analysis.lock_order import LockOrderPass
 from elasticdl_tpu.analysis.rpc_discipline import RpcDisciplinePass
 from elasticdl_tpu.analysis.thread_hygiene import ThreadHygienePass
 
@@ -157,6 +159,408 @@ def test_hot_path_except_handler_exempt():
                     time.sleep(1.0)  # error path: off the hot path
     """
     assert _lint(src, [HotPathSyncPass()]) == []
+
+
+# ---- blocking-propagation (v2: interprocedural) ----
+
+# The tentpole's motivating hole: the helper wraps block_until_ready, the
+# hot-path caller has no primitive of its own.  r7's hot-path-sync is
+# provably blind to it; blocking-propagation must fire on the call edge.
+BLOCKING_VIA_HELPER = """
+    class W:
+        def _settle(self):
+            self.state.block_until_ready()
+
+        # hot-path
+        def dispatch(self):
+            self._settle()
+"""
+
+
+def test_blocking_via_helper_missed_by_r7_caught_by_propagation():
+    src = textwrap.dedent(BLOCKING_VIA_HELPER)
+    assert lint_text(src, [HotPathSyncPass()]) == []  # r7: provably silent
+    findings = lint_text(src, [BlockingPropagationPass()])
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "blocking-propagation"
+    assert "_settle" in f.message and "block_until_ready" in f.message
+
+
+def test_blocking_propagation_two_levels_deep_with_witness_chain():
+    src = """
+        import time
+
+        def _inner():
+            time.sleep(1.0)
+
+        def _outer():
+            _inner()
+
+        class W:
+            # hot-path
+            def dispatch(self):
+                self._go()
+
+            def _go(self):
+                _outer()
+    """
+    findings = _lint(src, [BlockingPropagationPass()])
+    assert len(findings) == 1
+    # The witness names every hop down to the primitive.
+    msg = findings[0].message
+    assert "_go" in msg and "_outer" in msg and "_inner" in msg
+    assert "time.sleep" in msg
+
+
+def test_blocking_propagation_clean_twins():
+    # Accounted (phase boundary at the call site OR inside the helper),
+    # waived primitives, and error-path calls do not propagate.
+    src = """
+        import time
+
+        class W:
+            def _accounted(self):
+                with self.phases.phase("checkpoint"):
+                    self.state.block_until_ready()
+
+            def _waived(self):
+                # graftlint: allow[hot-path-sync] idle poll is the work here
+                time.sleep(0.1)
+
+            def _blocks(self):
+                time.sleep(0.1)
+
+            # hot-path
+            def dispatch(self):
+                self._accounted()
+                self._waived()
+                with self.phases.phase("control"):
+                    self._blocks()
+                try:
+                    pass
+                except Exception:
+                    self._blocks()
+    """
+    assert _lint(src, [BlockingPropagationPass()]) == []
+
+
+def test_blocking_propagation_waivable_at_call_site():
+    src = """
+        class W:
+            def _settle(self):
+                self.state.block_until_ready()
+
+            # hot-path
+            def dispatch(self):
+                # graftlint: allow[blocking-propagation] startup settle, runs once
+                self._settle()
+    """
+    assert _lint(src, [BlockingPropagationPass()]) == []
+
+
+# ---- lock-order (v2: interprocedural) ----
+
+LOCK_INVERSION = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._l1 = threading.Lock()
+            self._l2 = threading.Lock()
+
+        def path_a(self):
+            with self._l1:
+                self._take2()
+
+        def _take2(self):
+            with self._l2:
+                pass
+
+        def path_b(self):
+            with self._l2:
+                with self._l1:
+                    pass
+"""
+
+
+def test_lock_order_reports_cycle_with_witness_path():
+    findings = _lint(LOCK_INVERSION, [LockOrderPass()])
+    cycles = [f for f in findings if "potential deadlock" in f.message]
+    assert len(cycles) == 1
+    msg = cycles[0].message
+    # Full witness: both lock names and the file:line of each hop.
+    assert "C._l1" in msg and "C._l2" in msg
+    assert "path_a" in msg or "_take2" in msg
+    assert "path_b" in msg
+    assert "fixture.py:" in msg
+
+
+def test_lock_order_clean_consistent_nesting():
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._l1 = threading.Lock()
+                self._l2 = threading.Lock()
+
+            def path_a(self):
+                with self._l1:
+                    self._take2()
+
+            def _take2(self):
+                with self._l2:
+                    pass
+
+            def path_b(self):
+                with self._l1:
+                    with self._l2:
+                        pass
+    """
+    assert _lint(src, [LockOrderPass()]) == []
+
+
+def test_lock_order_self_deadlock_through_helper():
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    self._inner()
+
+            def _inner(self):
+                with self._lock:
+                    pass
+    """
+    findings = _lint(src, [LockOrderPass()])
+    assert len(findings) == 1
+    assert "self-deadlock" in findings[0].message
+
+
+def test_lock_order_leaf_annotation_enforced():
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._leaf = threading.Lock()  # lock-order: leaf
+                self._other = threading.Lock()
+
+            def bad(self):
+                with self._leaf:
+                    with self._other:
+                        pass
+    """
+    findings = _lint(src, [LockOrderPass()])
+    assert len(findings) == 1
+    assert "leaf" in findings[0].message
+
+
+def test_lock_order_before_annotation_enforced():
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()  # lock-order: before(_b)
+                self._b = threading.Lock()
+
+            def ok(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def bad(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """
+    findings = _lint(src, [LockOrderPass()])
+    # The declared-order violation plus the cycle the two paths form.
+    assert any("before" in f.message for f in findings)
+
+
+def test_lock_order_closure_does_not_inherit_held_set():
+    # A closure runs later on another thread: the lock held lexically
+    # around the def is NOT held when the closure's body acquires.
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()  # lock-order: leaf
+                self._b = threading.Lock()
+
+            def go(self):
+                with self._a:
+                    def bg():
+                        with self._b:
+                            pass
+                    t = threading.Thread(target=bg, daemon=True)
+                t.start()
+    """
+    assert _lint(src, [LockOrderPass()]) == []
+
+
+def test_lock_order_locksan_kwargs_must_match_comment():
+    src = """
+        from elasticdl_tpu.common import locksan
+
+        class C:
+            def __init__(self):
+                self._a = locksan.lock("C._a", leaf=True)
+    """
+    findings = _lint(src, [LockOrderPass()])
+    assert len(findings) == 1
+    assert "disagrees" in findings[0].message
+    clean = """
+        from elasticdl_tpu.common import locksan
+
+        class C:
+            def __init__(self):
+                self._a = locksan.lock("C._a", leaf=True)  # lock-order: leaf
+    """
+    assert _lint(clean, [LockOrderPass()]) == []
+
+
+def test_lock_order_locksan_name_must_match_attribute():
+    src = """
+        from elasticdl_tpu.common import locksan
+
+        class C:
+            def __init__(self):
+                self._a = locksan.lock("C._wrong")
+    """
+    findings = _lint(src, [LockOrderPass()])
+    assert len(findings) == 1 and "does not match" in findings[0].message
+
+
+def test_lock_order_malformed_annotation_is_finding():
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()  # lock-order: sideways
+    """
+    findings = _lint(src, [LockOrderPass()])
+    assert len(findings) == 1 and "malformed" in findings[0].message
+
+
+# ---- stale-waiver ----
+
+def test_stale_waiver_flagged_when_nothing_suppressed():
+    src = """
+        import time
+
+        class W:
+            # hot-path
+            def f(self):
+                # graftlint: allow[hot-path-sync] this line no longer blocks
+                x = 1
+                return x
+    """
+    findings = _lint(src, [HotPathSyncPass()])
+    assert _rules(findings) == {"stale-waiver"}
+    assert "suppresses no finding" in findings[0].message
+
+
+def test_live_waiver_not_stale():
+    src = """
+        import time
+
+        class W:
+            # hot-path
+            def f(self):
+                # graftlint: allow[hot-path-sync] idle poll is the work here
+                time.sleep(0.1)
+    """
+    assert _lint(src, [HotPathSyncPass()]) == []
+
+
+def test_stale_waiver_only_judged_for_rules_that_ran():
+    # A thread-hygiene waiver cannot be judged stale by a run that never
+    # executed the thread-hygiene pass.
+    src = """
+        def f():
+            # graftlint: allow[thread-hygiene] joined in caller scope
+            pass
+    """
+    assert _lint(src, [HotPathSyncPass()]) == []
+    findings = _lint(src, [ThreadHygienePass()])
+    assert _rules(findings) == {"stale-waiver"}
+
+
+def test_propagation_blocking_waiver_is_not_stale():
+    # The waiver on a non-hot helper's primitive is load-bearing: it stops
+    # the primitive from propagating to hot callers.  The full suite must
+    # neither propagate NOR call the waiver stale.
+    src = """
+        import time
+
+        class W:
+            def _poll(self):
+                # graftlint: allow[hot-path-sync] idle poll is the work here
+                time.sleep(0.1)
+
+            # hot-path
+            def dispatch(self):
+                self._poll()
+    """
+    assert _lint(src, all_passes()) == []
+
+
+def test_lock_order_condition_is_reentrant():
+    # threading.Condition() wraps an RLock: same-thread nested entry (even
+    # through a helper) is legal and must not read as a self-deadlock.
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._cond = threading.Condition()
+
+            def outer(self):
+                with self._cond:
+                    self._inner()
+
+            def _inner(self):
+                with self._cond:
+                    pass
+    """
+    assert _lint(src, [LockOrderPass()]) == []
+
+
+# ---- --changed dependents ----
+
+def test_module_dependents_transitive_closure():
+    srcs = _sources({
+        "pkg/__init__.py": "",
+        "pkg/helper.py": "x = 1\n",
+        "pkg/mid.py": "from pkg.helper import x\n",
+        "pkg/root.py": "from pkg.mid import x\n",
+        "pkg/unrelated.py": "y = 2\n",
+    })
+    deps = module_dependents(srcs, {"pkg/helper.py"})
+    assert deps == {"pkg/helper.py", "pkg/mid.py", "pkg/root.py"}
+
+
+def test_module_dependents_changed_package_init():
+    # Importing pkg.sub.mod executes pkg/sub/__init__: a changed package
+    # __init__ makes every importer underneath it a dependent.
+    srcs = _sources({
+        "pkg/__init__.py": "",
+        "pkg/sub/__init__.py": "",
+        "pkg/sub/mod.py": "y = 2\n",
+        "pkg/user.py": "from pkg.sub.mod import y\n",
+    })
+    deps = module_dependents(srcs, {"pkg/sub/__init__.py"})
+    assert "pkg/user.py" in deps
 
 
 # ---- compat-shim ----
@@ -500,6 +904,60 @@ def test_cli_artifact_stamps_counts_and_code_rev(tmp_path):
     assert rec["files_scanned"] > 50
     assert "code_rev" in rec and "rules" in rec
     assert "command" in rec  # write_artifact's shared stamp
+
+
+def test_cli_json_includes_waiver_inventory():
+    out = subprocess.run(
+        [sys.executable, "tools/graftlint.py", "elasticdl_tpu", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    doc = json.loads(out.stdout)
+    assert set(doc) == {"findings", "waivers"}
+    assert doc["findings"] == []
+    # The repo carries reasoned waivers; each inventory entry is complete.
+    assert len(doc["waivers"]) > 0
+    for w in doc["waivers"]:
+        assert set(w) == {"path", "line", "rule", "reason"}
+        assert w["reason"]
+
+
+def test_cli_artifact_has_lock_graph_and_blocking_roots(tmp_path):
+    art = tmp_path / "LINT_test.json"
+    out = subprocess.run(
+        [
+            sys.executable, "tools/graftlint.py", "elasticdl_tpu", "tools",
+            "--artifact", str(art),
+        ],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    rec = json.loads(art.read_text())
+    assert rec["blocking_roots"]["count"] > 0
+    assert rec["lock_graph"]["locks"] > 10
+    assert rec["lock_graph"]["locksan_wrapped"] > 10
+    # The one statically visible nesting: GetGroupTask -> GetTask.
+    assert [
+        "elasticdl_tpu.master.servicer:MasterServicer._group_lock",
+        "elasticdl_tpu.master.servicer:MasterServicer._lock",
+    ] in rec["lock_graph"]["edges"]
+    assert "Worker._ckpt_lock" in " ".join(rec["lock_graph"]["leaf"])
+    assert rec["waivers"] == len(
+        [None] * sum(rec["waivers_by_rule"].values())
+    )
+
+
+def test_cli_callgraph_dump():
+    out = subprocess.run(
+        [sys.executable, "tools/graftlint.py", "elasticdl_tpu", "--callgraph"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stderr
+    doc = json.loads(out.stdout)
+    assert doc["functions"] > 100
+    assert any("Worker.run" in q for q in doc["hot_path_functions"])
+    assert "elasticdl_tpu.worker.worker:Worker._ckpt_lock" in doc["locks"]
+    assert doc["locks"]["elasticdl_tpu.worker.worker:Worker._ckpt_lock"]["leaf"]
 
 
 def test_cli_changed_fails_loud_when_git_unreadable():

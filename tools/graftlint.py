@@ -3,7 +3,8 @@
 Usage:
     python tools/graftlint.py [paths...]         # default: elasticdl_tpu tools
     python tools/graftlint.py --changed          # git-diff-scoped fast mode
-    python tools/graftlint.py --json             # machine-readable findings
+    python tools/graftlint.py --json             # findings + waiver inventory
+    python tools/graftlint.py --callgraph        # dump the v2 call/lock graph
     python tools/graftlint.py --artifact [PATH]  # stamp LINT artifact
     python tools/graftlint.py --list-rules
 
@@ -11,8 +12,15 @@ Exit code 0 = clean, 1 = findings, 2 = usage/internal error.  Pure stdlib
 and jax-free by design (the import-hygiene pass guards this file too): the
 pre-commit path must cost milliseconds, never a backend init.
 
+``--changed`` scopes reporting to files changed vs HEAD (plus untracked)
+AND their module-level DEPENDENTS: the project-wide passes (import-hygiene,
+lock-order, blocking-propagation) judge whole-graph properties, so a change
+to a helper module must re-lint every module that imports it.  Install as a
+pre-commit hook with tools/precommit.sh (see docs/static_analysis.md).
+
 Waiver syntax (inline, same line as the finding or the comment-only line
-above): ``# graftlint: allow[<rule>] <reason>`` — reason mandatory; see
+above): ``# graftlint: allow[<rule>] <reason>`` — reason mandatory; a
+waiver that suppresses nothing is itself a finding (``stale-waiver``); see
 docs/static_analysis.md for the invariant catalogue.
 """
 
@@ -31,6 +39,7 @@ if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
 DEFAULT_PATHS = ("elasticdl_tpu", "tools")
+ARTIFACT_NAME = "LINT_r08.json"
 
 
 def _changed_files(repo: str) -> Optional[List[str]]:
@@ -55,6 +64,39 @@ def _changed_files(repo: str) -> Optional[List[str]]:
     return sorted({p for p in out if p.endswith(".py")})
 
 
+def _callgraph_dump(sources) -> dict:
+    """The v2 interprocedural model, machine-readable: function/edge
+    counts, blocking roots, and the lock graph with its annotations."""
+    from elasticdl_tpu.analysis.callgraph import shared_graph
+
+    g = shared_graph(sources)
+    edges = g.lock_edges()
+    return {
+        "functions": sum(1 for f in g.functions.values() if f.resolvable),
+        "call_edges": sum(
+            len(f.calls) for f in g.functions.values() if f.resolvable
+        ),
+        "hot_path_functions": sorted(
+            q for q, f in g.functions.items() if f.hot_path
+        ),
+        "blocking_roots": g.blocking_roots(),
+        "locks": {
+            lock_id: {
+                "declared_at": f"{d.path}:{d.line}",
+                "locksan": d.is_locksan,
+                "leaf": d.rt_leaf,
+                "before": list(d.rt_before),
+                "reentrant": d.reentrant,
+            }
+            for lock_id, d in sorted(g.locks.items())
+        },
+        "lock_edges": [
+            {"held": a, "acquired": b, "witness": w}
+            for (a, b), w in sorted(edges.items())
+        ],
+    }
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="graftlint", description=__doc__,
@@ -67,32 +109,40 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument(
         "--changed", action="store_true",
         help="lint only files changed vs HEAD (plus untracked) under the "
-        "given paths — pre-commit fast mode; project-wide passes still "
-        "see the full file set",
+        "given paths, PLUS modules that import them — pre-commit fast "
+        "mode; project-wide passes still see the full file set",
     )
     parser.add_argument(
         "--json", action="store_true", dest="as_json",
-        help="emit findings as a JSON array",
+        help="emit {findings: [...], waivers: [...]} as JSON",
+    )
+    parser.add_argument(
+        "--callgraph", action="store_true",
+        help="dump the interprocedural model (functions, blocking roots, "
+        "lock graph) as JSON and exit",
     )
     parser.add_argument(
         "--artifact", nargs="?", const="", default=None, metavar="PATH",
-        help="write a LINT artifact (findings count + per-rule counts + "
-        "code_rev) via tools/artifact.py; optional explicit path, else "
-        "artifacts/LINT_r07.json (env override LINT_OUT)",
+        help="write a LINT artifact (findings + per-rule counts + waiver "
+        "inventory + lock-graph/blocking-root stats + code_rev) via "
+        f"tools/artifact.py; optional explicit path, else "
+        f"artifacts/{ARTIFACT_NAME} (env override LINT_OUT)",
     )
     parser.add_argument(
         "--list-rules", action="store_true", help="list rules and exit"
     )
     args = parser.parse_args(argv)
 
-    from elasticdl_tpu.analysis import all_passes
-    from elasticdl_tpu.analysis.core import iter_file_paths, run_lint
+    from elasticdl_tpu.analysis import all_passes, collect_waivers
+    from elasticdl_tpu.analysis.core import iter_file_paths, run_lint_full
 
     passes = all_passes()
     if args.list_rules:
         for p in passes:
-            print(f"{p.name:18s} {p.description}")
-        print(f"{'waiver-syntax':18s} waivers must be "
+            print(f"{p.name:20s} {p.description}")
+        print(f"{'stale-waiver':20s} a waiver that suppresses no finding is "
+              "itself a finding")
+        print(f"{'waiver-syntax':20s} waivers must be "
               "'# graftlint: allow[<rule>] <reason>' with a known rule")
         return 0
 
@@ -110,6 +160,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     all_files = iter_file_paths(roots)
     only_paths = None
+    preloaded = None
+    n_changed = n_dependents = 0
     if args.changed:
         changed = _changed_files(_REPO_ROOT)
         if changed is None:
@@ -125,20 +177,47 @@ def main(argv: Optional[List[str]] = None) -> int:
             for fp in all_files
             if os.path.relpath(fp, _REPO_ROOT) in changed_set
         }
-    findings = run_lint(
-        roots, passes, rel_to=_REPO_ROOT, only_paths=only_paths
+        n_changed = len(only_paths)
+        # Project-wide passes judge whole-graph properties: re-lint every
+        # module that imports a changed one, or a helper edit could break
+        # an unchanged root silently (import-hygiene chains, lock-order
+        # edges, blocking propagation all cross module boundaries).
+        from elasticdl_tpu.analysis.core import load_sources
+        from elasticdl_tpu.analysis.import_hygiene import module_dependents
+
+        preloaded = load_sources(all_files, rel_to=_REPO_ROOT)
+        deps = module_dependents(preloaded[0], only_paths)
+        n_dependents = len(deps - only_paths)
+        only_paths |= deps
+
+    findings, sources = run_lint_full(
+        roots, passes, rel_to=_REPO_ROOT, only_paths=only_paths,
+        preloaded=preloaded,
     )
+    waivers = collect_waivers(sources, only_paths=only_paths)
+
+    if args.callgraph:
+        # Findings still gate the exit code — render them (stderr, so the
+        # stdout JSON stays parseable) or a failing dump is undiagnosable.
+        for f in findings:
+            print(f.render(), file=sys.stderr)
+        print(json.dumps(_callgraph_dump(sources), indent=1, sort_keys=True))
+        return 1 if findings else 0
 
     if args.as_json:
         print(json.dumps(
-            [f.__dict__ for f in findings], indent=1, sort_keys=True
+            {
+                "findings": [f.__dict__ for f in findings],
+                "waivers": waivers,
+            },
+            indent=1, sort_keys=True,
         ))
     else:
         for f in findings:
             print(f.render())
         scope = (
-            f"{len(only_paths)} changed" if only_paths is not None
-            else str(len(all_files))
+            f"{n_changed} changed (+{n_dependents} dependent)"
+            if only_paths is not None else str(len(all_files))
         )
         print(
             f"graftlint: {len(findings)} finding(s) across {scope} file(s)",
@@ -149,16 +228,37 @@ def main(argv: Optional[List[str]] = None) -> int:
         from tools.artifact import code_rev, write_artifact
 
         by_rule = Counter(f.rule for f in findings)
+        waivers_by_rule = Counter(w["rule"] for w in waivers)
+        cg = _callgraph_dump(sources)
         write_artifact(
             {
                 "findings": len(findings),
                 "by_rule": dict(sorted(by_rule.items())),
+                "waivers": len(waivers),
+                "waivers_by_rule": dict(sorted(waivers_by_rule.items())),
                 "files_scanned": len(all_files),
                 "changed_only": bool(args.changed),
                 "rules": sorted(p.name for p in passes),
+                "blocking_roots": {
+                    "count": len(cg["blocking_roots"]),
+                    "functions": cg["blocking_roots"],
+                },
+                "lock_graph": {
+                    "locks": len(cg["locks"]),
+                    "locksan_wrapped": sum(
+                        1 for d in cg["locks"].values() if d["locksan"]
+                    ),
+                    "leaf": sorted(
+                        k for k, d in cg["locks"].items() if d["leaf"]
+                    ),
+                    "edges": [
+                        [e["held"], e["acquired"]] for e in cg["lock_edges"]
+                    ],
+                },
+                "hot_path_functions": len(cg["hot_path_functions"]),
                 "code_rev": code_rev(),
             },
-            "LINT_r07.json",
+            ARTIFACT_NAME,
             env_var="LINT_OUT",
             path=args.artifact or None,
         )

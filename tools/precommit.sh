@@ -1,0 +1,15 @@
+#!/bin/sh
+# graftlint pre-commit hook: lint changed files (plus their module-level
+# dependents — project-wide passes judge whole-graph properties) before
+# every commit.  Pure stdlib, no jax import: costs milliseconds.
+#
+# Install (from the repo root):
+#     ln -sf ../../tools/precommit.sh .git/hooks/pre-commit
+# or, to keep an existing hook, call this script from it.
+#
+# Bypass for a work-in-progress commit (the tier-1 gate still runs the
+# full lint): git commit --no-verify
+set -u
+repo_root="$(git rev-parse --show-toplevel)" || exit 2
+cd "$repo_root" || exit 2
+exec python tools/graftlint.py --changed
