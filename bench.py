@@ -198,12 +198,15 @@ def _emit(
                 prev_rev = rec.get("code_rev")
             except Exception:
                 pass
-            # Best-run-wins is a SAME-REVISION guard: across runs of the
-            # same code it keeps the healthy-link number (the tunnel's wire
-            # is bimodal), but once the code changes the record must follow
-            # the code — otherwise a genuine regression can never lower the
-            # number of record.  Unknown/missing revs (old artifacts, no
-            # git) count as "different": the fresh run wins.
+            # Best-run-wins is a SAME-REVISION, SAME-PIPELINE-CONFIG guard:
+            # across runs of the same code AND the same ingest/prep/lease
+            # shape it keeps the healthy-link number (the tunnel's wire is
+            # bimodal), but once either changes the record must follow the
+            # fresh run — throughput at ingest_threads=4 and at 1 are
+            # different experiments, and a genuine regression must be able
+            # to lower the number of record.  Unknown/missing revs or
+            # pipeline stamps (old artifacts, no git) count as "different":
+            # the fresh run wins.
             same_rev = (
                 prev_rev is not None
                 and prev_rev != ""
@@ -211,6 +214,8 @@ def _emit(
                 # the same dirty HEAD can be running different code.
                 and not prev_rev.endswith("-dirty")
                 and prev_rev == line["code_rev"]
+                and rec.get("pipeline") is not None
+                and rec.get("pipeline") == line.get("pipeline")
             )
             if prev is None or (
                 value is not None and (not same_rev or value >= prev)
@@ -376,6 +381,13 @@ def main() -> None:
         k: (round(v, 3) if isinstance(v, float) else v)
         for k, v in e2e.items()
         if k != "e2e_examples_per_sec_per_chip"
+    }
+    # Pipeline shape of record (r9): like the link fields, throughput is
+    # only comparable at equal ingest/prep/lease config — the record guard
+    # in _emit treats a different shape as a different experiment.
+    extras["pipeline"] = {
+        k: e2e[k] for k in ("ingest_threads", "prep_depth", "lease_batch")
+        if k in e2e
     }
     _log("done", f"end-to-end {e2e_eps:,.0f} examples/sec/chip "
                  f"(device-step ceiling {eps_per_chip:,.0f})")

@@ -79,6 +79,25 @@ class JobConfig:
     # previous task's metrics fetch + report with this task's dispatched
     # steps.  Formerly also coupled to --prefetch_depth; same fix.
     task_pipelining: bool = True
+    # Parallel ingest (r9, data/ingest_pool.py): a task's record range is
+    # split into minibatch-aligned sub-chunks read+decoded concurrently on
+    # a bounded thread pool (the C++ codec and recordio read release the
+    # GIL), reassembled in order so the stacked batch is bit-identical to
+    # the serial path.  0 = auto (host cores, capped at 4); 1 = serial
+    # (the pre-r9 path, byte for byte).  Only engages on readers declaring
+    # thread_safe_ranges.
+    ingest_threads: int = 0
+    # Prep-ahead pipeline depth: up to this many leased tasks have their
+    # host half (read + decode + stack) in flight concurrently while
+    # earlier tasks' device work streams.  1 = the r6 one-slot behavior.
+    # Each in-flight prep holds one task's stacked host batch in memory.
+    prep_depth: int = 2
+    # Batched task leases: GetTask/GetGroupTask may hand out up to this
+    # many tasks per RPC (one control-plane RTT amortized over the batch);
+    # the worker buffers the extras locally and returns unstarted ones to
+    # the master on preemption or membership change.  1 = one task per
+    # RPC (the pre-r9 wire behavior).
+    lease_batch: int = 4
 
     # --- schedule ---
     minibatch_size: int = 64
@@ -230,6 +249,12 @@ class JobConfig:
             raise ValueError("--num_ps_pods cannot be negative")
         if self.prefetch_depth < 0:
             raise ValueError("--prefetch_depth cannot be negative")
+        if self.ingest_threads < 0:
+            raise ValueError("--ingest_threads cannot be negative (0 = auto)")
+        if self.prep_depth < 1:
+            raise ValueError("--prep_depth must be >= 1")
+        if self.lease_batch < 1:
+            raise ValueError("--lease_batch must be >= 1")
         if self.async_staleness < 1:
             raise ValueError("--async_staleness must be >= 1")
         if self.dcn_data_parallelism < 1:

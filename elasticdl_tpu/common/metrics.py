@@ -89,9 +89,20 @@ class PhaseTimers:
     - ``metrics``     host-side metric aggregation + the report RPC
     - ``checkpoint``  task-loop boundary cost of periodic checkpoints
                       (snapshot dispatch + in-flight-save joins + final save)
-    - ``control``     task-acquisition RPCs (Heartbeat/GetTask/GetGroupTask)
+    - ``control``     task-boundary control-plane overhead (heartbeat +
+                      membership checks; the lease RPC nests under it and
+                      keeps only its own time)
+    - ``lease_wait``  the task-lease RPC itself (GetTask/GetGroupTask) —
+                      with batched leases (r9) this fires once per batch,
+                      so its per-task share is the lease amortization win
     - ``checkpoint_bg``  background checkpoint write + commit-barrier time —
                       OFF the critical path, excluded from wall sums
+    - ``decode_parallel``  cumulative ingest-pool thread time in parallel
+                      chunk read+decode (r9) — runs CONCURRENTLY with the
+                      foreground phases (and with itself, across threads),
+                      so it is off the critical path like ``checkpoint_bg``;
+                      compare it against ``prep_wait`` to see how much
+                      decode the pool hid
 
     The snapshot rides every ReportTaskResult/ReportCheckpoint, so the
     master's view (JobStatus ``phase_times``) and the train-job artifact get
@@ -152,10 +163,12 @@ class PhaseTimers:
 
 
 #: Phases that consume task-loop wall-clock (everything but the background
-#: checkpoint write).  Consumers summing a decomposition against wall time
-#: must restrict to these.
+#: checkpoint write and the ingest pool's parallel decode time, which run
+#: concurrently with the foreground phases).  Consumers summing a
+#: decomposition against wall time must restrict to these.
 CRITICAL_PATH_PHASES = (
     "prep_wait", "dispatch", "step_wait", "metrics", "checkpoint", "control",
+    "lease_wait",
 )
 
 

@@ -50,14 +50,30 @@ _DICT = (dict,)
 #: table — asserted by tests).  Unknown fields pass through (forward
 #: compatibility, like proto3 unknown fields).
 MASTER_SCHEMAS: Dict[str, MessageSchema] = {
-    "GetTask": MessageSchema(required={"worker_id": _STR}),
+    # lease (r9): how many tasks the caller can accept in one response —
+    # the master may return up to that many in the response's "tasks"
+    # (GetTask) / "entries" (GetGroupTask) list, amortizing one RPC RTT
+    # over the batch.  Optional and additive: an absent field means 1,
+    # and old callers ignore the extra response keys, so no PROTOCOL_VERSION
+    # bump (proto3 unknown-field stance on both sides).
+    "GetTask": MessageSchema(
+        required={"worker_id": _STR}, optional={"lease": _INT}
+    ),
     "GetGroupTask": MessageSchema(
-        required={"worker_id": _STR, "seq": _INT, "version": _INT}
+        required={"worker_id": _STR, "seq": _INT, "version": _INT},
+        optional={"lease": _INT},
     ),
     "ReportTaskResult": MessageSchema(
         required={"worker_id": _STR, "task_id": _INT, "success": _BOOL},
         optional={
             "task_type": _STR,
+            # requeue (r9): success=False with requeue=True means the task
+            # was returned UNSTARTED (lease/prep abandon on preemption or
+            # membership change) — the dispatcher requeues it without
+            # charging the retry budget, so routine elastic churn cannot
+            # poison-abandon a healthy task.  Additive; absent = a real
+            # failure.
+            "requeue": _BOOL,
             "metrics": _DICT,
             "weight": _NUM,
             "model_version": _INT,

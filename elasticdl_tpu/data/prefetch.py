@@ -26,11 +26,16 @@ class _Failure:
         self.exc = exc
 
 
-def prefetch(iterable: Iterable, depth: int = 2) -> Iterator:
+def prefetch(
+    iterable: Iterable, depth: int = 2, name: str = "edl-prefetch"
+) -> Iterator:
     """Iterate ``iterable`` on a daemon thread, keeping up to ``depth`` items
     decoded ahead.  Exceptions raised by the producer re-raise at the
     consumer's next pull (fail-loud: a malformed record must kill the task,
     not vanish into a thread).  ``depth < 1`` returns the iterable unchanged.
+    ``name`` labels the producer thread (the worker passes
+    ``prefetch:<task_id>``) so thread dumps and locksan reports attribute
+    ingest threads to the task that owns them.
 
     A consumer that abandons iteration early (task failure mid-shard)
     cancels the producer: the generator's close/GC sets the cancel event,
@@ -69,9 +74,7 @@ def prefetch(iterable: Iterable, depth: int = 2) -> Iterator:
         # eagerly started producer would then spin on 0.1 s put-retries
         # forever, pinning ``depth`` decoded batches.  Starting the thread
         # on the first pull means no pull, no thread, no leak.
-        threading.Thread(
-            target=_produce, name="edl-prefetch", daemon=True
-        ).start()
+        threading.Thread(target=_produce, name=name, daemon=True).start()
         try:
             while True:
                 item = q.get()

@@ -34,6 +34,14 @@ class Shard:
 class AbstractDataReader:
     """Stateless, range-addressable record source."""
 
+    #: True when concurrent ``read_records``/``read_records_packed`` calls
+    #: on DISJOINT ranges of one source are safe from multiple threads —
+    #: the opt-in the worker's parallel ingest (data/ingest_pool.py)
+    #: requires before splitting a task's range across pool threads.
+    #: File-backed readers open a fresh handle per read, so they qualify;
+    #: readers holding a shared connection (sqlite tables) do not.
+    thread_safe_ranges = False
+
     def create_shards(self, records_per_shard: int) -> List[Shard]:
         raise NotImplementedError
 
@@ -68,6 +76,8 @@ def _range_shards(sizes: Dict[str, int], records_per_shard: int) -> List[Shard]:
 
 
 class RecordIODataReader(AbstractDataReader):
+    thread_safe_ranges = True  # per-read file handles; shared offsets index
+
     def __init__(self, data_path: str, **_):
         self._readers = {p: RecordIOReader(p) for p in _expand(data_path)}
 
@@ -96,6 +106,11 @@ class CSVDataReader(AbstractDataReader):
     ``skip_header=True`` drops the first line of each file.  Line offsets are
     indexed once per file (same trade as the recordio scan).
     """
+
+    # Per-read file handles; a cold offsets index built concurrently is an
+    # idempotent double-compute (both threads assign equal lists), not a
+    # correctness hazard.
+    thread_safe_ranges = True
 
     def __init__(self, data_path: str, skip_header: bool = False, **_):
         self._files = _expand(data_path)
@@ -172,6 +187,10 @@ class CompositeDataReader(AbstractDataReader):
         for reader in self._readers:
             for source in reader.sources():
                 self._by_source[source] = reader
+        # Parallel range reads are only safe when EVERY routed reader is.
+        self.thread_safe_ranges = all(
+            getattr(r, "thread_safe_ranges", False) for r in self._readers
+        )
 
     def create_shards(self, records_per_shard: int) -> List[Shard]:
         return [

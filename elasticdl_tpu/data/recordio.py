@@ -17,12 +17,27 @@ from __future__ import annotations
 import os
 import struct
 import zlib
-from typing import Iterator, List, Optional, Sequence
+from collections import OrderedDict
+from typing import Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from elasticdl_tpu.common import locksan
+
 MAGIC = b"EDLRIO\x00\x01"
 _HDR = struct.Struct("<II")
+
+#: Process-level offsets cache, keyed by ``(path, mtime_ns, size)``: the
+#: e2e worker re-opens the same file once per task (and, since r9, once
+#: per parallel ingest chunk), and every fresh ``RecordIOReader`` used to
+#: pay the full index scan again.  Keying on mtime+size means an appended
+#: or rewritten file can never serve a stale index — its old entry just
+#: ages out.  Bounded LRU; offsets lists are append-only after insertion
+#: (readers treat them as immutable), so sharing one list across reader
+#: instances and threads is safe.
+_INDEX_CACHE: "OrderedDict[Tuple[str, int, int], List[int]]" = OrderedDict()
+_INDEX_CACHE_MAX = 64
+_index_cache_lock = locksan.lock("_index_cache_lock", leaf=True)  # lock-order: leaf
 
 
 class RecordIOWriter:
@@ -60,10 +75,21 @@ class RecordIOReader:
                 raise ValueError(f"{path}: not a recordio file")
 
     def index(self) -> List[int]:
-        """Byte offset of each record (cached one-time scan)."""
+        """Byte offset of each record (one-time scan, shared process-wide
+        through the ``(path, mtime, size)``-keyed cache — sub-chunk readers
+        and per-task reader instances must not re-scan the same bytes)."""
         if self._offsets is None:
+            st = os.stat(self.path)
+            key = (self.path, st.st_mtime_ns, st.st_size)
+            with _index_cache_lock:
+                cached = _INDEX_CACHE.get(key)
+                if cached is not None:
+                    _INDEX_CACHE.move_to_end(key)
+            if cached is not None:
+                self._offsets = cached
+                return cached
             offsets = []
-            size = os.path.getsize(self.path)
+            size = st.st_size
             with open(self.path, "rb") as f:
                 pos = len(MAGIC)
                 while pos < size:
@@ -71,6 +97,11 @@ class RecordIOReader:
                     f.seek(pos)
                     length, _ = _HDR.unpack(f.read(_HDR.size))
                     pos += _HDR.size + length
+            with _index_cache_lock:
+                _INDEX_CACHE[key] = offsets
+                _INDEX_CACHE.move_to_end(key)
+                while len(_INDEX_CACHE) > _INDEX_CACHE_MAX:
+                    _INDEX_CACHE.popitem(last=False)
             self._offsets = offsets
         return self._offsets
 

@@ -103,6 +103,15 @@ class EvaluationService:
             dispatcher = self._dispatcher
         return dispatcher.recover_tasks(worker_id) if dispatcher else []
 
+    def tasks_pending(self) -> bool:
+        """True while the in-flight round still has UNDISPATCHED tasks —
+        the servicer's heartbeat hint (r9) that a worker holding buffered
+        training leases should return them and pull the eval work, keeping
+        the round's model-version skew at the pre-lease bound."""
+        with self._lock:
+            dispatcher = self._dispatcher
+        return dispatcher is not None and dispatcher.counts()["todo"] > 0
+
     # -- metric aggregation --
 
     def report_metrics(self, metrics: Dict[str, float], weight: float) -> None:

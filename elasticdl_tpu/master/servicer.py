@@ -9,8 +9,9 @@ exposes the same handlers over gRPC for real deployments.
 
 Method table (the wire contract):
 
-  GetTask            {worker_id}                       -> {task?, finished}
-  GetGroupTask       {worker_id, seq, version}         -> {task?, finished, stale}
+  GetTask            {worker_id, lease?}               -> {task?, tasks?, finished}
+  GetGroupTask       {worker_id, seq, version, lease?} -> {task?, finished, stale,
+                                                          entries?}
   ReportTaskResult   {worker_id, task_id, success,
                       metrics?, weight?, model_version?} -> {accepted}
   ReportVersion      {worker_id, model_version}        -> {}
@@ -163,6 +164,13 @@ class MasterServicer:
     # hot-path: one call per worker poll interval; must never sleep/block
     def GetTask(self, req: dict) -> dict:
         worker_id = req["worker_id"]
+        # Batched lease (r9): hand out up to ``lease`` training tasks in
+        # one RPC — the response's "tasks" carries the whole batch and
+        # "task" stays its first element for pre-lease consumers.  Eval
+        # tasks are never batched: a round wants its tasks spread across
+        # workers and scored against one model version, so an eval hand-out
+        # preempts the batch exactly as it preempted the single task.
+        lease = max(1, int(req.get("lease", 1)))
         if self._epoch_end_eval:
             self._drain_pending_epoch_evals()
         # Eval rounds preempt training tasks so metrics snapshot a consistent
@@ -187,10 +195,11 @@ class MasterServicer:
             task = self.evaluation.get_task(worker_id)
             if task is not None:
                 return {"task": task.to_dict(), "finished": False}
-        task = self.dispatcher.get_task(worker_id)
-        if task is None:
+        tasks = self.dispatcher.get_tasks(worker_id, lease)
+        if not tasks:
             return {"task": None, "finished": self.job_finished()}
-        return {"task": task.to_dict(), "finished": False}
+        dicts = [t.to_dict() for t in tasks]
+        return {"task": dicts[0], "tasks": dicts, "finished": False}
 
     @staticmethod
     def group_worker_id(version: int) -> str:
@@ -205,9 +214,19 @@ class MasterServicer:
         must re-check membership (which restarts it in multihost mode).  A
         transient ``{task: None, finished: False}`` is NOT logged — callers
         retry the same seq.
+
+        ``lease`` (r9) batches the log walk: the response's ``entries``
+        carries up to ``lease`` consecutive log entries starting at ``seq``
+        (materializing through GetTask as needed), and ``task``/``finished``
+        mirror the first entry for pre-lease consumers.  Batching is pure
+        read-ahead of the shared log — whichever member asks first
+        materializes, every member sees the identical sequence, and a
+        membership change still invalidates the whole log (and requeues its
+        in-flight tasks) exactly as before.
         """
         seq = int(req["seq"])
         version = int(req["version"])
+        lease = max(1, int(req.get("lease", 1)))
         stale = {"task": None, "finished": False, "stale": True}
         if version != self.rendezvous.version():
             return stale
@@ -223,14 +242,6 @@ class MasterServicer:
                         self.evaluation.recover_tasks(old)
                 self._group_version = version
                 self._group_log = []
-            if seq < len(self._group_log):
-                return dict(self._group_log[seq], stale=False)
-            if not self.rendezvous.all_confirmed(version):
-                # A member still holds (or may hold) an older topology view;
-                # issuing a collective task now would wedge the others inside
-                # the collective waiting for it.  Withhold until every member
-                # has confirmed this version (heartbeat/registration).
-                return {"task": None, "finished": False, "stale": False}
             if seq > len(self._group_log):
                 # A process can only be at most one entry ahead of the log;
                 # anything else is a protocol bug or a stale world — restart.
@@ -239,12 +250,57 @@ class MasterServicer:
                     seq, len(self._group_log), version,
                 )
                 return stale
-            resp = self.GetTask({"worker_id": self.group_worker_id(version)})
-            if resp["task"] is None and not resp["finished"]:
+            entries = []
+            s = seq
+            while len(entries) < lease:
+                if s < len(self._group_log):
+                    entries.append(self._group_log[s])
+                else:
+                    if entries and self._under_drain_or_eval_pressure():
+                        # Every materialized entry commits the WHOLE gang
+                        # to training it (lockstep contract), so read-ahead
+                        # under a max-steps drain or a pending eval round
+                        # would widen the overshoot/skew by up to
+                        # lease_batch-1 tasks — fall back to the pre-lease
+                        # one-entry-per-call walk until the pressure
+                        # clears.  Already-logged entries above still
+                        # serve: the gang is committed to those.
+                        break
+                    if not self.rendezvous.all_confirmed(version):
+                        # A member still holds (or may hold) an older
+                        # topology view; issuing a collective task now would
+                        # wedge the others inside the collective waiting for
+                        # it.  Withhold until every member has confirmed
+                        # this version (heartbeat/registration).
+                        break
+                    resp = self.GetTask(
+                        {"worker_id": self.group_worker_id(version)}
+                    )
+                    if resp["task"] is None and not resp["finished"]:
+                        break  # transient: not logged, caller retries seq
+                    entry = {"task": resp["task"], "finished": resp["finished"]}
+                    self._group_log.append(entry)
+                    entries.append(entry)
+                s += 1
+                if entries[-1]["finished"]:
+                    break  # the job-end marker closes the log
+            if not entries:
                 return {"task": None, "finished": False, "stale": False}
-            entry = {"task": resp["task"], "finished": resp["finished"]}
-            self._group_log.append(entry)
-            return dict(entry, stale=False)
+            return dict(
+                entries[0], stale=False, entries=[dict(e) for e in entries]
+            )
+
+    def _under_drain_or_eval_pressure(self) -> bool:
+        """True when new lockstep-log entries should not be materialized
+        ahead of need: the max-steps drain has begun, or an eval round has
+        undispatched tasks (the group-mode twin of the worker-side
+        draining/eval_pending heartbeat handling, which group workers
+        deliberately skip — the log, not the worker, owns the gang's
+        order)."""
+        with self._lock:
+            if self._max_steps_hit:
+                return True
+        return self.evaluation is not None and self.evaluation.tasks_pending()
 
     def job_finished(self) -> bool:
         """True when training tasks drained AND any pending/in-flight eval is done."""
@@ -282,7 +338,8 @@ class MasterServicer:
             self._maybe_write_eval_metrics()
         else:
             accepted = self.dispatcher.report(
-                task_id, success, req.get("worker_id", "")
+                task_id, success, req.get("worker_id", ""),
+                requeue_only=bool(req.get("requeue", False)),
             )
             if success and accepted and req.get("metrics") and self.metrics_writer:
                 with self._lock:
@@ -431,11 +488,28 @@ class MasterServicer:
         # (their reports are rank-0-gated away); slot update only, no
         # metrics-stream mirror — heartbeats arrive every poll interval.
         self._record_phase_times(req, stream=False)
-        return {
+        resp = {
             "version": self.rendezvous.heartbeat(
                 req["worker_id"], req.get("version")
             )
         }
+        # Eval-preemption hint (r9): batched leases would otherwise let a
+        # worker train up to lease_batch-1 buffered tasks before its next
+        # GetTask sees a pending eval round, widening the round's
+        # model-version skew — the hint makes lease-holding workers return
+        # their buffer (immediate requeue) and pull the eval work instead.
+        if self.evaluation is not None and self.evaluation.tasks_pending():
+            resp["eval_pending"] = True
+        # Drain hint (r9): past --max_steps the dispatcher stops, but it
+        # cannot recall leases a worker already buffers — without the hint
+        # the worker would train up to lease_batch-1 tasks beyond the
+        # configured limit.  On seeing it the worker returns its buffer;
+        # the STOPPED dispatcher drops the returned tasks (they must not
+        # retrain), restoring the pre-lease overshoot bound.
+        with self._lock:
+            if self._max_steps_hit:
+                resp["draining"] = True
+        return resp
 
     def GetMembership(self, req: dict) -> dict:
         return self.rendezvous.membership()

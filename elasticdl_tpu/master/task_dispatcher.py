@@ -203,21 +203,52 @@ class TaskDispatcher:
         None with ``finished() == False`` means "in-flight tasks remain;
         poll again" (their failure may requeue work).
         """
+        tasks = self.get_tasks(worker_id, 1)
+        return tasks[0] if tasks else None
+
+    # hot-path: behind every (batched) worker GetTask poll
+    def get_tasks(self, worker_id: str, n: int) -> List[Task]:
+        """Lease up to ``n`` tasks to ``worker_id`` in one locked pass (the
+        batched-lease RPC, r9).  Every handed-out task enters ``doing``
+        individually, so the existing elasticity machinery — timeout
+        requeue, ``recover_tasks`` on worker loss, at-least-once reports —
+        covers leased-but-unstarted tasks with no new state: a lost worker's
+        whole lease requeues exactly once through the same path as its
+        in-flight task.  Epoch refill semantics are unchanged: a batch
+        never crosses an epoch boundary mid-call (the refill only fires
+        when todo AND doing are both empty)."""
         with self._lock:
             self._requeue_timed_out()
             self._refill()
-            task = None
-            if self._todo:
+            tasks: List[Task] = []
+            while self._todo and len(tasks) < n:
                 task = self._todo.popleft()
-                self._doing[task.task_id] = _Doing(task, worker_id, self._clock())
+                self._doing[task.task_id] = _Doing(
+                    task, worker_id, self._clock()
+                )
+                tasks.append(task)
         self._fire_epoch_end()
-        return task
+        return tasks
 
     # hot-path: behind every task report
-    def report(self, task_id: int, success: bool, worker_id: str = "") -> bool:
+    def report(
+        self,
+        task_id: int,
+        success: bool,
+        worker_id: str = "",
+        requeue_only: bool = False,
+    ) -> bool:
         """Record a task result; requeue on failure.  Returns False for an
         unknown/stale id (e.g. a task already requeued by the timeout path —
-        the late result is ignored, matching at-least-once semantics)."""
+        the late result is ignored, matching at-least-once semantics).
+
+        ``requeue_only`` (r9): the task was returned UNSTARTED (a worker
+        giving back a buffered lease or an undispatched prep on preemption
+        or membership change) — requeue it without touching the retry
+        budget.  Counting these as failures would let routine elastic churn
+        poison-abandon a healthy task: with batched leases a task can sit
+        in some worker's buffer across max_task_retries separate scale
+        events without ever having run."""
         with self._lock:
             entry = self._doing.pop(task_id, None)
             if entry is None:
@@ -231,6 +262,8 @@ class TaskDispatcher:
                 # dropped, not requeued — requeueing would re-open dispatch
                 # and train past the configured limit.
                 self._abandoned += 1
+            elif requeue_only:
+                self._todo.appendleft(entry.task)
             else:
                 fails = self._failed_counts.get(task_id, 0) + 1
                 self._failed_counts[task_id] = fails

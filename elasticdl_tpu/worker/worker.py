@@ -17,8 +17,9 @@ from __future__ import annotations
 import os
 import threading
 import time
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Dict, Iterable, List, Optional, Sequence
+from typing import Any, Dict, Iterable, List, NamedTuple, Optional, Sequence
 
 import jax
 import numpy as np
@@ -29,8 +30,9 @@ from elasticdl_tpu.common.config import JobConfig
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.metrics import PhaseTimers, finalize_metrics
 from elasticdl_tpu.common.rpc import PROTOCOL_VERSION, JsonRpcClient
+from elasticdl_tpu.data.ingest_pool import IngestPool, plan_chunks
 from elasticdl_tpu.data.prefetch import prefetch
-from elasticdl_tpu.data.reader import AbstractDataReader
+from elasticdl_tpu.data.reader import AbstractDataReader, Shard
 from elasticdl_tpu.master.task_dispatcher import (
     TASK_EVALUATION,
     TASK_PREDICTION,
@@ -111,6 +113,23 @@ class WorkerRestartRequired(RuntimeError):
 RESTART_EXIT_CODE = 3
 
 
+class HostPrep(NamedTuple):
+    """Result of a training task's host half (read + decode + stack).
+
+    ``stacked`` is the ``[T, mb, ...]`` host batch over the ``n_full`` full
+    minibatches (None when the task has none); ``tail`` is the plain record
+    list past the last full minibatch (at most one minibatch — it trains as
+    a wrap-padded masked step); ``total`` is the task's true record count.
+    The parallel ingest path (data/ingest_pool.py) produces this from
+    per-chunk decodes reassembled in record order, so it is bit-identical
+    to the serial read — the contract tests pin."""
+
+    total: int
+    n_full: int
+    stacked: Optional[dict]
+    tail: List[bytes]
+
+
 class Worker:
     def __init__(
         self,
@@ -165,13 +184,27 @@ class Worker:
         # metrics), fetched + reported only after the NEXT task's steps are
         # dispatched (see _dispatch_training_task for why).
         self._pending: Optional[tuple] = None
-        # Prep-ahead pipeline (fused + pipelined mode): the NEXT training
-        # task's (task, report, host-prep future).  The host half (bulk
-        # read + C++ decode + stacking) runs on a one-thread pool while the
-        # previous task's transfer streams and its metrics settle, keeping
-        # the host<->device link continuously busy (see run()).
-        self._prep_next: Optional[tuple] = None
+        # Prep-ahead pipeline (fused + pipelined mode): a bounded k-deep
+        # queue of (task, report, host-prep future) for leased tasks whose
+        # host half (bulk read + C++ decode + stacking) is in flight on the
+        # prep pool while earlier tasks' transfers stream and metrics
+        # settle (see run()).  Depth = config.prep_depth; 1 reproduces the
+        # r6 one-slot behavior.  Each prep fans its chunk decodes out to
+        # the shared IngestPool (config.ingest_threads).
+        self._prep_queue: deque = deque()
         self._prep_pool = None
+        # Built eagerly (ThreadPoolExecutor spawns its threads lazily on
+        # first submit, so an eval/predict-only job still pays nothing):
+        # prep_depth > 1 means _prep_fused_host runs concurrently on prep
+        # threads, and a lazy check-then-create there would race into two
+        # pools of decode threads competing for the same cores.
+        self._ingest = IngestPool(config.ingest_threads)
+        # Locally buffered task leases (batched GetTask/GetGroupTask, r9):
+        # tasks the master leased in one RPC beyond the one being started.
+        # Unstarted leases are returned to the master on preemption or
+        # membership change (_abandon_leases) so elasticity semantics stay
+        # requeue-on-loss/at-least-once.
+        self._leased: deque = deque()
         self._tasks_done = 0
         # Python-side step counter mirroring state.step: reading the device
         # scalar would drain the dispatch pipeline at every task boundary.
@@ -447,14 +480,41 @@ class Worker:
             # to see it.
             hb["phase_times"] = self.phases.snapshot()
         resp = self.master.call("Heartbeat", hb)
+        if not self._group_mode and resp.get("draining"):
+            # Max-steps drain: buffered leases AND undispatched prepped
+            # tasks carry no device work yet — return them all (requeue-
+            # flagged; the STOPPED dispatcher drops them, so nothing
+            # trains past the limit).  Overshoot shrinks to the tasks
+            # already dispatched, the pre-lease pipeline bound.
+            self._abandon_prep()
+            self._abandon_leases()
+        elif (
+            resp.get("eval_pending")
+            and self._leased
+            and not self._group_mode
+        ):
+            # A pending eval round preempts training tasks; buffered
+            # leases would delay it by up to lease_batch-1 tasks of
+            # version skew.  Return them (immediate requeue) so the next
+            # lease RPC pulls the eval task first — prepped tasks keep
+            # their decode investment and still train, exactly the
+            # pre-r9 preemption granularity.  Group mode is exempt from
+            # both hints: the lockstep log already fixes the global
+            # order.
+            self._abandon_leases()
         if resp["version"] != self._membership_version:
-            # Settle the in-flight pipelined task before re-forming: a
+            # Settle the in-flight pipelined tasks before re-forming: a
             # multihost change raises WorkerRestartRequired out of
             # _apply_membership, and an unflushed report would leave the
             # master waiting out the task timeout to requeue.  The prepped
-            # task (if any) dispatches on the OLD mesh first — its state is
-            # settled before the re-form.
+            # tasks (if any) dispatch on the OLD mesh first — their state
+            # is settled before the re-form.  Locally buffered leases, by
+            # contrast, have no work invested: return them to the master
+            # NOW (immediate requeue) rather than carrying them across a
+            # membership whose lease the master may already have
+            # invalidated.
             self._drain_prep()
+            self._abandon_leases()
             membership = self.master.call("GetMembership", {})
             self._apply_membership(membership)
 
@@ -777,23 +837,80 @@ class Worker:
             dict(big),
         )
 
-    def _prep_fused_host(self, task: Task) -> tuple:
+    def _prep_fused_host(self, task: Task) -> HostPrep:
         """Host half of a fused training task: bulk read + C++ decode +
         [T, mb, ...] stacking.  Touches neither ``self.state`` nor the
         device, so the prep-ahead pipeline in ``run`` executes it on a
         background thread (the C++ codec and numpy copies release the GIL)
-        while the previous task's wire transfer and metrics settle."""
-        records = self._read_records(task.shard)
+        while the previous task's wire transfer and metrics settle.
+
+        With ``ingest_threads`` > 1 (and a reader declaring
+        ``thread_safe_ranges``) the task's record range splits into
+        minibatch-aligned sub-chunks read+decoded concurrently on the
+        IngestPool, reassembled in chunk order — record order, ragged-tail
+        records, and therefore the ``__mask__``/gradient-weighting
+        semantics are bit-identical to the serial path (the feed decodes
+        each record independently, so a chunked feed concatenates to
+        exactly the serial feed's bytes)."""
         mb = self.config.minibatch_size
-        n_full = len(records) // mb
-        stacked = None
-        if n_full >= 1:
-            stacked = self._stack_full_minibatches(records, mb, n_full)
-        return records, stacked, n_full
+        shard = task.shard
+        pool = self._ingest
+        chunks = (
+            plan_chunks(shard.start, shard.end, mb, pool.threads)
+            if pool.parallel
+            and getattr(self.reader, "thread_safe_ranges", False)
+            else None
+        )
+        if not chunks or len(chunks) < 2:
+            records = self._read_records(shard)
+            total = len(records)
+            n_full = total // mb
+            stacked = (
+                self._stack_full_minibatches(records, mb, n_full)
+                if n_full >= 1
+                else None
+            )
+            return HostPrep(total, n_full, stacked, list(records[n_full * mb:]))
+
+        def _decode_chunk(span):
+            # Runs on an ingest-pool thread; its cumulative time lands in
+            # the off-critical-path ``decode_parallel`` phase (the phase
+            # stack is per-thread, so this never subtracts from the
+            # foreground phases).
+            with self.phases.phase("decode_parallel"):
+                recs = self._read_records(Shard(shard.name, span[0], span[1]))
+                t = len(recs) // mb
+                stacked = (
+                    self._stack_full_minibatches(recs, mb, t)
+                    if t >= 1
+                    else None
+                )
+                return len(recs), t, stacked, list(recs[t * mb:])
+
+        parts = pool.map_ordered(_decode_chunk, chunks)
+        total = sum(p[0] for p in parts)
+        n_full = sum(p[1] for p in parts)
+        stacks = [p[2] for p in parts if p[2] is not None]
+        if not stacks:
+            stacked = None
+        elif len(stacks) == 1:
+            stacked = stacks[0]
+        else:
+            # Ordered concat along the step axis: chunk i's [t_i, mb, ...]
+            # rows precede chunk i+1's, exactly the serial reshape's layout.
+            stacked = {
+                k: np.concatenate([s[k] for s in stacks], axis=0)
+                for k in stacks[0]
+            }
+        # plan_chunks puts the ragged tail on the LAST chunk, so only it
+        # can have leftover records.
+        return HostPrep(total, n_full, stacked, parts[-1][3])
 
     # hot-path: THE dispatch function — every blocking transfer here shows
     # up as device idle on the remote-attached chip
-    def _dispatch_training_task(self, task: Task, prep: tuple = None) -> tuple:
+    def _dispatch_training_task(
+        self, task: Task, prep: Optional[HostPrep] = None
+    ) -> tuple:
         """Dispatch every device step of a training task WITHOUT blocking on
         results.  Returns (per-batch device metrics, n_steps).
 
@@ -809,14 +926,23 @@ class Worker:
 
         ``prep`` is an already-computed ``_prep_fused_host`` result (the
         prep-ahead pipeline); when None the host work runs inline here.
+        Prep is only ever produced on the fused pre-shard path
+        (``_prep_ahead_eligible``), so a prepped task either takes the
+        fused branch (``n_full >= 1``) or is a pure-tail task whose records
+        are exactly ``prep.tail``.
         """
+        mb = self.config.minibatch_size
         if prep is not None:
-            records = prep[0]
+            records = None
+            total, n_full, stacked_host, tail = prep
         else:
             with self.phases.phase("prep_wait"):
                 records = self._read_records(task.shard)
-        mb = self.config.minibatch_size
-        n_steps = (len(records) + mb - 1) // mb
+            total = len(records)
+            n_full = total // mb
+            stacked_host = None
+            tail = records[n_full * mb:]
+        n_steps = (total + mb - 1) // mb
         pre_shard = not self.spec.host_io
 
         def _train_feed(chunk, true_count):
@@ -831,8 +957,6 @@ class Worker:
                 )
             return batch
 
-        n_full = prep[2] if prep is not None else len(records) // mb
-        stacked_host = prep[1] if prep is not None else None
         try:
             if pre_shard and self.config.fused_task_scan and n_full >= 1:
                 # Whole-task fused path: ONE feed call over every full
@@ -857,9 +981,7 @@ class Worker:
                         self.state, self.trainer.shard_stacked_batch(stacked)
                     )
                     metrics_list = [scan_metrics]  # [T]-stacked dict
-                    for chunk, true_count in _minibatches(
-                        records[n_full * mb :], mb, True
-                    ):
+                    for chunk, true_count in _minibatches(tail, mb, True):
                         self.state, m = self.trainer.train_step(
                             self.state,
                             self.trainer.shard_batch(
@@ -868,8 +990,14 @@ class Worker:
                         )
                         metrics_list.append(m)
             else:
+                # Inline: the full record list.  Prepped: only reachable as
+                # a pure-tail task (n_full == 0), whose records ARE the tail.
+                gen_records = records if records is not None else tail
+
                 def _gen():
-                    for chunk, true_count in _minibatches(records, mb, True):
+                    for chunk, true_count in _minibatches(
+                        gen_records, mb, True
+                    ):
                         batch = _train_feed(chunk, true_count)
                         yield (
                             self.trainer.shard_batch(batch)
@@ -887,7 +1015,11 @@ class Worker:
                 with self.phases.phase("dispatch"):
                     self.state, metrics_list = self.trainer.run_train_steps(
                         self.state,
-                        prefetch(_gen(), self.config.prefetch_depth),
+                        prefetch(
+                            _gen(),
+                            self.config.prefetch_depth,
+                            name=f"prefetch:{task.task_id}",
+                        ),
                         use_async=self.config.use_async,
                         pre_sharded=pre_shard,
                     )
@@ -1162,8 +1294,21 @@ class Worker:
     # hot-path: submission only — the prep itself runs on the pool thread
     def _submit_prep(self, task: Task):
         if self._prep_pool is None:
+            # One prep thread per pipeline slot: every queued task's host
+            # half runs concurrently (each fanning its chunk decodes out to
+            # the shared IngestPool), so a slow shard never serializes the
+            # preps behind it.  A reader that does NOT declare
+            # thread_safe_ranges (shared-connection sources) keeps the
+            # pre-r9 one-thread pool: the k-deep queue still buffers k
+            # leased tasks, but their reads serialize — concurrent
+            # _read_records calls are exactly what such readers forbid.
+            width = (
+                max(1, self.config.prep_depth)
+                if getattr(self.reader, "thread_safe_ranges", False)
+                else 1
+            )
             self._prep_pool = ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="edl-prep"
+                max_workers=width, thread_name_prefix="edl-prep"
             )
         return self._prep_pool.submit(self._prep_fused_host, task)
 
@@ -1175,7 +1320,7 @@ class Worker:
         task.  Single-process: a failure (prep or dispatch) fails THIS
         task's report — the master requeues it — exactly as the inline
         dispatch path does, and nothing is raised: the caller has often
-        just queued a NEW task into ``_prep_next`` whose report dict the
+        just queued a NEW task into ``_prep_queue`` whose report dict the
         run loop's outer exception handler would wrongly fail — a task the
         master would requeue while this worker still holds (and later
         trains) it, double-training its records.  Lost reports are the
@@ -1226,35 +1371,130 @@ class Worker:
             )
 
     def _drain_prep(self) -> None:
-        """Run the prep-ahead slot to completion (dispatch + settle both
-        deferred slots): called whenever something must observe a fully
-        settled task order — eval/predict tasks, membership changes, idle
-        polls, job end."""
-        prepped, self._prep_next = self._prep_next, None
-        if prepped is not None:
-            self._dispatch_prepped(prepped)
+        """Run the prep-ahead queue to completion (dispatch every prepped
+        task, then settle the deferred report slot): called whenever
+        something must observe a fully settled task order — eval/predict
+        tasks, membership changes, idle polls, job end.  A group resync
+        raised mid-drain leaves the remaining entries queued; the restart's
+        membership bump requeues them master-side."""
+        while self._prep_queue:
+            self._dispatch_prepped(self._prep_queue.popleft())
         self._flush_pending()
 
     def _abandon_prep(self) -> None:
-        """Give an undispatched prepped task back to the master (failure
+        """Give every undispatched prepped task back to the master (failure
         report -> immediate requeue) — the preemption path must not start
-        new device work, and silently dropping the task would make the
-        master wait out its timeout."""
-        prepped, self._prep_next = self._prep_next, None
-        if prepped is None:
+        new device work, and silently dropping a task would make the
+        master wait out its timeout.  Each queue entry is reported exactly
+        once; tasks already dispatched left the queue and report through
+        their pending slot instead (no double-report)."""
+        while self._prep_queue:
+            task, report, fut = self._prep_queue.popleft()
+            fut.cancel()  # not-yet-started prep must not compete with the
+            # preemption snapshot for host I/O inside the grace window
+            report["success"] = False
+            # No device work ran: requeue without charging the retry
+            # budget (a genuine failure this is not).
+            report["requeue"] = True
+            try:
+                self.master.call("ReportTaskResult", report)
+            except Exception:
+                logger.exception(
+                    "abandoning prepped task %d failed", task.task_id
+                )
+
+    def _abandon_leases(self) -> None:
+        """Return locally buffered (never-started) task leases to the
+        master: a failure report requeues each immediately, preserving the
+        at-least-once contract without waiting out the task timeout.  In
+        group mode the buffer is lockstep-log read-ahead attributed to the
+        group pseudo worker, and the master's log invalidation on a
+        membership change already requeues it — reporting from here would
+        double-requeue, so the local buffer is simply dropped."""
+        leased, self._leased = self._leased, deque()
+        if self._group_mode or not leased:
             return
-        task, report, fut = prepped
-        fut.cancel()  # not-yet-started prep must not compete with the
-        # preemption snapshot for host I/O inside the grace window
-        report["success"] = False
-        try:
-            self.master.call("ReportTaskResult", report)
-        except Exception:
-            logger.exception("abandoning prepped task %d failed", task.task_id)
+        for entry in leased:
+            t = entry.get("task")
+            if not t:
+                continue
+            report = {
+                "worker_id": self.worker_id,
+                "task_id": t["task_id"],
+                "task_type": t["type"],
+                "success": False,
+                # Never started: requeue without charging the retry budget.
+                "requeue": True,
+            }
+            try:
+                self.master.call("ReportTaskResult", report)
+            except Exception:
+                logger.exception(
+                    "abandoning leased task %d failed (master task "
+                    "timeout will requeue it)", t["task_id"],
+                )
 
     def _flush_pending(self) -> None:
         pending, self._pending = self._pending, None
         self._flush(pending)
+
+    # hot-path: steady-state task acquisition — buffered leases cost no
+    # RPC at all; the batched lease RPC is accounted under lease_wait
+    def _next_lease(self) -> dict:
+        """The next task entry: from the local lease buffer when one is
+        held, else one batched GetTask/GetGroupTask RPC (up to
+        ``lease_batch`` tasks per round-trip — the r5 loop paid a full
+        control-plane RTT per task).  Returns the wire shape
+        ``{task?, finished, stale}``; extra leased tasks are buffered and
+        consumed on later iterations (and returned to the master by
+        ``_abandon_leases`` if preemption or a membership change strikes
+        first)."""
+        if self._leased:
+            return self._leased.popleft()
+        n = max(1, self.config.lease_batch)
+        if self._group_mode:
+            # Lockstep pull: every process of the world executes the same
+            # task sequence (the jitted step is a collective over all their
+            # devices); the master's group log keys entries by seq, and the
+            # lease batches the log walk.
+            with self.phases.phase("lease_wait"):
+                resp = self.master.call(
+                    "GetGroupTask",
+                    {
+                        "worker_id": self.worker_id,
+                        "seq": self._task_seq,
+                        "version": self._membership_version,
+                        "lease": n,
+                    },
+                )
+            if resp.get("stale"):
+                return resp
+            entries = resp.get("entries") or [
+                {"task": resp["task"], "finished": resp["finished"]}
+            ]
+            self._leased.extend(
+                {"task": e["task"], "finished": e["finished"], "stale": False}
+                for e in entries[1:]
+            )
+            return {
+                "task": entries[0]["task"],
+                "finished": entries[0]["finished"],
+                "stale": False,
+            }
+        with self.phases.phase("lease_wait"):
+            resp = self.master.call(
+                "GetTask", {"worker_id": self.worker_id, "lease": n}
+            )
+        tasks = resp.get("tasks")
+        if tasks:
+            self._leased.extend(
+                {"task": t, "finished": False, "stale": False}
+                for t in tasks[1:]
+            )
+            return {"task": tasks[0], "finished": False, "stale": False}
+        return {
+            "task": resp["task"], "finished": resp["finished"], "stale": False
+        }
 
     def _run_evaluation_task(self, task: Task) -> tuple:
         records = self._read_records(task.shard)
@@ -1303,7 +1543,8 @@ class Worker:
                 yield batch, true_count
 
         for batch, true_count in prefetch(
-            _batches(), self.config.prefetch_depth
+            _batches(), self.config.prefetch_depth,
+            name=f"prefetch:{task.task_id}",
         ):
             metrics = self.trainer.run_eval_step(self.state, batch)
             _accumulate(metrics, true_count)
@@ -1326,6 +1567,7 @@ class Worker:
                 )
             ),
             self.config.prefetch_depth,
+            name=f"prefetch:{task.task_id}",
         ):
             out = self.trainer.run_predict_step(self.state, batch)
             outs.append(np.asarray(out)[:true_count])
@@ -1430,32 +1672,22 @@ class Worker:
                 # this loop only abandons and sleeps, so self.state can no
                 # longer be donated or reassigned.
                 self._parked = True
-                # Give an undispatched prepped task straight back to the
-                # master (it must not start device work now), then park.
+                # Give undispatched prepped tasks and unstarted leases
+                # straight back to the master (they must not start device
+                # work now), then park.
                 # graftlint: allow[blocking-propagation] parked for preemption: the abandon report is the last useful work
                 self._abandon_prep()
+                # graftlint: allow[blocking-propagation] parked for preemption: returning unstarted leases is the last useful work
+                self._abandon_leases()
                 # graftlint: allow[hot-path-sync] parked for preemption: the loop must only idle here
                 time.sleep(self._poll)
                 continue
             with self.phases.phase("control"):
                 self._check_membership()
-                if self._group_mode:
-                    # Lockstep pull: every process of the world executes the
-                    # same task (the jitted step is a collective over all
-                    # their devices); the master's group log keys entries by
-                    # seq.
-                    resp = self.master.call(
-                        "GetGroupTask",
-                        {
-                            "worker_id": self.worker_id,
-                            "seq": self._task_seq,
-                            "version": self._membership_version,
-                        },
-                    )
-                else:
-                    resp = self.master.call(
-                        "GetTask", {"worker_id": self.worker_id}
-                    )
+                # Buffered lease or one batched GetTask/GetGroupTask RPC
+                # (the lease RPC's wall lands in the nested lease_wait
+                # phase; control keeps only the heartbeat + loop overhead).
+                resp = self._next_lease()
             if self._group_mode and resp.get("stale"):
                 # World changed under us: the next membership check
                 # raises WorkerRestartRequired.
@@ -1499,22 +1731,26 @@ class Worker:
                     try:
                         if pipelined and self._prep_ahead_eligible():
                             # Prep-ahead: submit THIS task's host work to
-                            # the background thread, then dispatch + settle
-                            # the PREVIOUSLY prepped task while it decodes.
-                            # The wire transfer of task N streams while
-                            # task N+1 decodes and task N-1's metrics
-                            # settle — three tasks in flight, link busy
-                            # end to end.  In group mode the submission
-                            # rides the gang task-acquisition path (this
-                            # task was just pulled at its seq), so the
-                            # prepped dispatch below stays inside the
-                            # lockstep boundary of the task it belongs to.
+                            # the prep pool, then dispatch + settle the
+                            # OLDEST prepped task once the queue exceeds
+                            # its depth.  At depth k the wire transfer of
+                            # task N streams while tasks N+1..N+k decode
+                            # and task N-1's metrics settle — k+2 tasks in
+                            # flight, link busy end to end.  In group mode
+                            # the submission rides the gang
+                            # task-acquisition path (this task was just
+                            # pulled at its seq), so every prepped
+                            # dispatch below stays inside the lockstep
+                            # boundary of the task it belongs to.
                             fut = self._submit_prep(task)
-                            prepped, self._prep_next = (
-                                self._prep_next, (task, report, fut),
-                            )
-                            if prepped is not None:
-                                self._dispatch_prepped(prepped)
+                            self._prep_queue.append((task, report, fut))
+                            while (
+                                len(self._prep_queue)
+                                > max(1, self.config.prep_depth)
+                            ):
+                                self._dispatch_prepped(
+                                    self._prep_queue.popleft()
+                                )
                             continue
                         if pipelined:
                             metrics_list, n_steps = (

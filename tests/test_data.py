@@ -436,3 +436,132 @@ def test_prefetch_abandoned_before_first_pull_starts_no_thread():
     gc.collect()
     assert threading.active_count() <= before, "producer started eagerly"
     assert produced == []
+
+
+# ---------------- parallel ingest (r9, data/ingest_pool.py) ----------------
+
+
+def test_plan_chunks_alignment_and_cover():
+    from elasticdl_tpu.data.ingest_pool import plan_chunks
+
+    # Interior boundaries minibatch-aligned, range covered exactly, tail on
+    # the last chunk, chunk count bounded by threads.
+    for start, end, mb, threads in (
+        (0, 100, 16, 4), (32, 131, 16, 4), (0, 5, 16, 4), (0, 64, 16, 3),
+        (7, 7, 16, 4), (0, 1000, 1, 8), (0, 33, 16, 2),
+    ):
+        chunks = plan_chunks(start, end, mb, threads)
+        assert chunks[0][0] == start and chunks[-1][1] == max(start, end)
+        for (a, b), (c, d) in zip(chunks, chunks[1:]):
+            assert b == c, chunks
+            assert (b - start) % mb == 0, chunks  # interior cut is aligned
+        assert len(chunks) <= max(1, threads)
+        # only the last chunk may hold a non-multiple of mb
+        for a, b in chunks[:-1]:
+            assert (b - a) % mb == 0
+    # nothing to split: single chunk back
+    assert plan_chunks(0, 31, 16, 4) == [(0, 31)]  # 1 full mb + tail
+    assert plan_chunks(0, 100, 16, 1) == [(0, 100)]
+
+
+def test_ingest_pool_map_ordered_preserves_order_and_raises():
+    from elasticdl_tpu.data.ingest_pool import IngestPool
+
+    pool = IngestPool(4)
+    assert pool.parallel and pool.threads == 4
+    try:
+        out = pool.map_ordered(lambda x: x * x, list(range(37)))
+        assert out == [x * x for x in range(37)]
+
+        def boom(x):
+            if x == 5:
+                raise ValueError("chunk failure")
+            return x
+
+        with pytest.raises(ValueError, match="chunk failure"):
+            pool.map_ordered(boom, list(range(8)))
+    finally:
+        pool.shutdown()
+    # serial degradation: no pool at all, same results
+    serial = IngestPool(1)
+    assert not serial.parallel
+    assert serial.map_ordered(lambda x: -x, [3, 1, 2]) == [-3, -1, -2]
+
+
+def test_parallel_chunk_decode_bit_identical(tmp_path):
+    """The r9 contract: chunked read+decode reassembled in chunk order is
+    byte-for-byte the serial path's output — record order preserved across
+    an mb-unaligned shard with a ragged tail."""
+    from elasticdl_tpu.data.ingest_pool import IngestPool, plan_chunks
+
+    path = str(tmp_path / "c.rio")
+    n, mb = 1000, 64  # 15 full minibatches + 40-record tail
+    synthetic.synthetic_criteo(path, n, seed=3, container="recordio")
+    reader = create_data_reader(path)
+    assert reader.thread_safe_ranges
+    shard = Shard(path, 0, n)
+
+    serial = codecs.criteo_feed_pre(reader.read_records_packed(shard), 4096)
+
+    pool = IngestPool(4)
+    try:
+        chunks = plan_chunks(shard.start, shard.end, mb, pool.threads)
+        assert len(chunks) == 4
+        parts = pool.map_ordered(
+            lambda span: codecs.criteo_feed_pre(
+                reader.read_records_packed(Shard(path, span[0], span[1])),
+                4096,
+            ),
+            chunks,
+        )
+    finally:
+        pool.shutdown()
+    merged = {
+        k: np.concatenate([p[k] for p in parts], axis=0) for k in serial
+    }
+    assert set(merged) == set(serial)
+    for k in serial:
+        assert merged[k].dtype == serial[k].dtype
+        np.testing.assert_array_equal(merged[k], serial[k])
+
+
+def test_recordio_offsets_cache_shared_across_readers(tmp_path):
+    """The process-level (path, mtime, size) offsets cache: a second reader
+    instance of the same unchanged file reuses the first's index (no
+    re-scan), while a rewritten file gets a fresh scan."""
+    from elasticdl_tpu.data import recordio as rio
+
+    path = str(tmp_path / "cache.rio")
+    write_records(path, [b"a" * 10, b"b" * 20, b"c" * 5])
+    r1 = RecordIOReader(path)
+    idx1 = r1.index()
+    r2 = RecordIOReader(path)
+    assert r2.index() is idx1  # shared list object: served from the cache
+
+    # Rewrite with different content: the key (mtime_ns, size) changes, so
+    # the stale index must not be reused.
+    import os as _os
+    write_records(path, [b"x" * 7, b"y" * 300])
+    _os.utime(path, ns=(1, 1))  # force a distinct mtime even on coarse fs
+    r3 = RecordIOReader(path)
+    idx3 = r3.index()
+    assert idx3 is not idx1 and len(idx3) == 2
+    assert list(r3.read_range(0, 2)) == [b"x" * 7, b"y" * 300]
+    # bounded: the cache never grows past its cap
+    assert len(rio._INDEX_CACHE) <= rio._INDEX_CACHE_MAX
+
+
+def test_prefetch_thread_name_attributes_task():
+    """The producer thread carries the caller's name (prefetch:<task_id>)
+    so thread dumps attribute ingest threads."""
+    import threading
+    from elasticdl_tpu.data.prefetch import prefetch
+
+    names = []
+
+    def gen():
+        names.append(threading.current_thread().name)
+        yield 1
+
+    assert list(prefetch(gen(), 2, name="prefetch:42")) == [1]
+    assert names == ["prefetch:42"]

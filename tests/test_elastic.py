@@ -44,8 +44,11 @@ def _deepfm_job(tmp_path, n_records=192, records_per_task=32, **cfg):
 
 def test_scale_4_8_4_mid_training(tmp_path, devices):
     """Phantom workers join then leave mid-job; the surviving worker re-forms
-    its mesh 4 -> 8 -> 4 and training completes with every task done."""
-    config, servicer, reader, spec = _deepfm_job(tmp_path)
+    its mesh 4 -> 8 -> 4 and training completes with every task done.
+    lease_batch=1 keeps the GetTask-call counter a per-task schedule (the
+    orchestration below injects membership events by call count); the
+    unbatched wire shape stays a supported config."""
+    config, servicer, reader, spec = _deepfm_job(tmp_path, lease_batch=1)
     worker = Worker(
         config, DirectMasterProxy(servicer), reader,
         worker_id="w0", spec=spec, devices=devices, devices_per_worker=4,
@@ -91,11 +94,15 @@ def test_worker_death_loses_no_data(tmp_path, devices):
     with pytest.raises(KeyboardInterrupt):
         doomed.run()
     status = servicer.JobStatus({})
-    # Three tasks in flight at death under the prep-ahead pipeline: task 0
-    # (dispatched, died before its deferred report), task 1 (died during
-    # dispatch), task 2 (prepped on the background thread, never started).
-    # All requeue on eviction — at-least-once semantics, nothing lost.
-    assert status["doing"] == 3
+    # Four tasks in flight at death under the prep-ahead pipeline with
+    # batched leases (lease_batch default covers all 4 shards in one RPC):
+    # task 0 (dispatched, died before its deferred report), task 1 (died
+    # during dispatch), task 2 (prepped on the background pool, never
+    # started), task 3 (leased, still buffered).  ALL requeue on eviction —
+    # the lease entered `doing` at hand-out, so worker loss invalidates it
+    # through the same recover_tasks path as in-flight work.  At-least-once
+    # semantics, nothing lost.
+    assert status["doing"] == 4
 
     # Master notices the death (here: pod event / heartbeat timeout path).
     servicer.rendezvous.remove("w-doomed")
@@ -157,6 +164,7 @@ def test_elastic_reform_resumes_from_checkpoint(tmp_path, devices):
         tmp_path,
         checkpoint_dir=str(tmp_path / "ckpt"),
         checkpoint_steps=2,
+        lease_batch=1,  # the GetTask counter below is a per-task schedule
     )
     worker = Worker(
         config, DirectMasterProxy(servicer), reader,

@@ -253,3 +253,213 @@ class TestGrpcTransport:
             client.close()
         finally:
             server.stop()
+
+
+# ---------------- batched task leases (r9) ----------------
+
+
+class TestBatchedLeases:
+    def test_dispatcher_get_tasks_leases_in_order(self):
+        d = TaskDispatcher(_shards(5))
+        tasks = d.get_tasks("w0", 3)
+        assert [t.task_id for t in tasks] == [0, 1, 2]
+        assert d.counts()["doing"] == 3 and d.counts()["todo"] == 2
+        # a second lease continues where the first stopped, clamped to the
+        # queue
+        more = d.get_tasks("w1", 10)
+        assert [t.task_id for t in more] == [3, 4]
+        assert d.counts()["todo"] == 0 and d.counts()["doing"] == 5
+        assert d.get_tasks("w0", 4) == []
+
+    def test_gettask_lease_response_shape(self):
+        servicer = MasterServicer(TaskDispatcher(_shards(4)))
+        resp = servicer.GetTask({"worker_id": "w0", "lease": 3})
+        assert len(resp["tasks"]) == 3
+        assert resp["task"] == resp["tasks"][0]  # pre-lease consumers
+        assert not resp["finished"]
+        # absent lease field = the old one-task wire shape (plus the batch
+        # list of length 1)
+        resp2 = servicer.GetTask({"worker_id": "w0"})
+        assert len(resp2["tasks"]) == 1
+
+    def test_lease_invalidation_on_worker_loss_requeues_exactly_once(self):
+        """Every leased-but-unfinished task of a lost worker re-queues
+        exactly once: the lease entered `doing` at hand-out, so the same
+        recover path that covers in-flight work covers the buffer — and a
+        second recovery (double eviction event) requeues nothing."""
+        rendezvous = RendezvousServer()
+        d = TaskDispatcher(_shards(4))
+        servicer = MasterServicer(d, rendezvous=rendezvous)
+        servicer.RegisterWorker({"worker_id": "w0"})
+        resp = servicer.GetTask({"worker_id": "w0", "lease": 4})
+        leased_ids = [t["task_id"] for t in resp["tasks"]]
+        assert len(leased_ids) == 4 and d.counts()["doing"] == 4
+        # w0 finishes one leased task, then dies holding the other three.
+        servicer.ReportTaskResult(
+            {"worker_id": "w0", "task_id": leased_ids[0], "success": True}
+        )
+        servicer.DeregisterWorker({"worker_id": "w0"})
+        c = d.counts()
+        assert c["doing"] == 0 and c["todo"] == 3 and c["done"] == 1
+        # exactly once: a straggling second recovery finds nothing
+        assert d.recover_tasks("w0") == []
+        assert d.counts()["todo"] == 3
+        # the requeued leases complete under a replacement worker
+        servicer.RegisterWorker({"worker_id": "w1"})
+        resp = servicer.GetTask({"worker_id": "w1", "lease": 8})
+        assert sorted(t["task_id"] for t in resp["tasks"]) == sorted(
+            leased_ids[1:]
+        )
+        for t in resp["tasks"]:
+            servicer.ReportTaskResult(
+                {"worker_id": "w1", "task_id": t["task_id"], "success": True}
+            )
+        assert d.finished() and d.counts()["done"] == 4
+
+    def test_group_task_lease_walks_log_consistently(self):
+        """GetGroupTask lease batching is shared-log read-ahead: whichever
+        member asks first materializes the entries; every member sees the
+        identical sequence, and the batch ends at the job-end marker."""
+        rendezvous = RendezvousServer()
+        servicer = MasterServicer(
+            TaskDispatcher(_shards(3)), rendezvous=rendezvous
+        )
+        v = rendezvous.register("w0")
+        v = rendezvous.register("w1")
+        rendezvous.heartbeat("w0", v)
+        rendezvous.heartbeat("w1", v)
+        r0 = servicer.GetGroupTask(
+            {"worker_id": "w0", "seq": 0, "version": v, "lease": 2}
+        )
+        assert not r0["stale"]
+        assert [e["task"]["task_id"] for e in r0["entries"]] == [0, 1]
+        assert r0["task"] == r0["entries"][0]["task"]
+        # the peer replays the SAME entries from the log
+        r1 = servicer.GetGroupTask(
+            {"worker_id": "w1", "seq": 0, "version": v, "lease": 2}
+        )
+        assert [e["task"]["task_id"] for e in r1["entries"]] == [0, 1]
+        # next batch: one real task left, then tasks drain; the lease stops
+        # rather than logging a transient none
+        for tid in (0, 1):
+            servicer.ReportTaskResult(
+                {"worker_id": "w0", "task_id": tid, "success": True}
+            )
+        r2 = servicer.GetGroupTask(
+            {"worker_id": "w0", "seq": 2, "version": v, "lease": 4}
+        )
+        ids = [e["task"]["task_id"] for e in r2["entries"] if e["task"]]
+        assert ids == [2]
+        servicer.ReportTaskResult(
+            {"worker_id": "w0", "task_id": 2, "success": True}
+        )
+        # the finished marker is logged and closes the batch
+        r3 = servicer.GetGroupTask(
+            {"worker_id": "w1", "seq": 3, "version": v, "lease": 4}
+        )
+        assert r3["entries"][-1]["finished"] and r3["entries"][-1]["task"] is None
+        # a version bump invalidates the log and requeues nothing twice
+        v2 = rendezvous.register("w2")
+        stale = servicer.GetGroupTask(
+            {"worker_id": "w0", "seq": 3, "version": v, "lease": 2}
+        )
+        assert stale["stale"]
+
+    def test_requeue_flag_does_not_charge_retry_budget(self):
+        """A lease/prep abandon (success=False, requeue=True) requeues
+        without counting as a failure: a task bounced by many elastic
+        events must never be poison-abandoned."""
+        d = TaskDispatcher(_shards(1), max_task_retries=2)
+        servicer = MasterServicer(d)
+        for _ in range(6):  # far past max_task_retries
+            t = servicer.GetTask({"worker_id": "w0"})["task"]
+            servicer.ReportTaskResult({
+                "worker_id": "w0", "task_id": t["task_id"],
+                "success": False, "requeue": True,
+            })
+        c = d.counts()
+        assert c["todo"] == 1 and c["abandoned"] == 0
+        # ...while real failures still burn the budget and poison out
+        for _ in range(3):
+            t = servicer.GetTask({"worker_id": "w0"})["task"]
+            servicer.ReportTaskResult({
+                "worker_id": "w0", "task_id": t["task_id"], "success": False,
+            })
+        assert d.counts()["abandoned"] == 1
+
+    def test_heartbeat_eval_pending_hint(self):
+        """The heartbeat carries eval_pending while an eval round has
+        undispatched tasks — the lease-return trigger that keeps eval
+        preemption prompt under batched leases."""
+        rendezvous = RendezvousServer()
+        evaluation = EvaluationService(_shards(2), evaluation_steps=5)
+        servicer = MasterServicer(
+            TaskDispatcher(_shards(2)), rendezvous=rendezvous,
+            evaluation=evaluation,
+        )
+        servicer.RegisterWorker({"worker_id": "w0"})
+        assert "eval_pending" not in servicer.Heartbeat({"worker_id": "w0"})
+        assert evaluation.trigger(1)
+        assert servicer.Heartbeat({"worker_id": "w0"})["eval_pending"] is True
+        # both eval tasks handed out -> nothing left to pull -> hint gone
+        e0 = servicer.GetTask({"worker_id": "w0"})["task"]
+        e1 = servicer.GetTask({"worker_id": "w0"})["task"]
+        assert {e0["type"], e1["type"]} == {TASK_EVALUATION}
+        assert "eval_pending" not in servicer.Heartbeat({"worker_id": "w0"})
+
+    def test_heartbeat_draining_hint_bounds_max_steps_overshoot(self):
+        """After --max_steps the heartbeat carries `draining`; returned
+        buffered leases are dropped by the stopped dispatcher (never
+        retrained), restoring the pre-lease overshoot bound."""
+        d = TaskDispatcher(_shards(4))
+        servicer = MasterServicer(
+            d, rendezvous=RendezvousServer(), max_steps=8
+        )
+        servicer.RegisterWorker({"worker_id": "w0"})
+        resp = servicer.GetTask({"worker_id": "w0", "lease": 4})
+        assert len(resp["tasks"]) == 4
+        assert "draining" not in servicer.Heartbeat({"worker_id": "w0"})
+        servicer.ReportTaskResult({
+            "worker_id": "w0", "task_id": resp["tasks"][0]["task_id"],
+            "success": True, "model_version": 8,
+        })
+        assert servicer.Heartbeat({"worker_id": "w0"})["draining"] is True
+        # the worker returns its buffer; the stopped dispatcher drops it
+        for t in resp["tasks"][1:]:
+            servicer.ReportTaskResult({
+                "worker_id": "w0", "task_id": t["task_id"],
+                "success": False, "requeue": True,
+            })
+        c = d.counts()
+        assert c["todo"] == 0 and c["doing"] == 0
+        assert c["done"] == 1 and c["abandoned"] == 3
+        assert d.finished()
+
+    def test_group_lease_read_ahead_clamps_under_eval_pressure(self):
+        """The lockstep log must not speculatively materialize training
+        entries past a pending eval round (or a max-steps drain): every
+        logged entry commits the whole gang.  Under pressure the batch
+        falls back to one new entry per call."""
+        rendezvous = RendezvousServer()
+        evaluation = EvaluationService(_shards(2), evaluation_steps=5)
+        servicer = MasterServicer(
+            TaskDispatcher(_shards(3)), rendezvous=rendezvous,
+            evaluation=evaluation,
+        )
+        v = rendezvous.register("w0")
+        rendezvous.heartbeat("w0", v)
+        assert evaluation.trigger(1)
+        r = servicer.GetGroupTask(
+            {"worker_id": "w0", "seq": 0, "version": v, "lease": 4}
+        )
+        # one eval entry materialized; the second eval task still pends,
+        # so NO training read-ahead happened behind it
+        assert len(r["entries"]) == 1
+        assert r["entries"][0]["task"]["type"] == TASK_EVALUATION
+        # pressure cleared (both eval tasks handed out): batching resumes
+        r2 = servicer.GetGroupTask(
+            {"worker_id": "w0", "seq": 1, "version": v, "lease": 4}
+        )
+        assert len(r2["entries"]) > 1
+        assert r2["entries"][0]["task"]["type"] == TASK_EVALUATION
+        assert r2["entries"][1]["task"]["type"] != TASK_EVALUATION

@@ -838,7 +838,7 @@ def test_group_prep_drained_on_preemption(tmp_path, devices):
         resp = orig_call(method, payload, **kw)
         # Preempt as soon as a prepped-but-undispatched task exists: the
         # NEXT loop iteration must park and abandon it.
-        if target._prep_next is not None and not target._preempting:
+        if target._prep_queue and not target._preempting:
             target._preempting = True
         return resp
 

@@ -423,10 +423,14 @@ def test_phase_time_decomposition(tmp_path, devices):
     phases = result["phase_times"]
     # the task loop's wall-consuming phases are all present...
     for name in ("prep_wait", "dispatch", "step_wait", "metrics",
-                 "checkpoint", "control"):
+                 "checkpoint", "control", "lease_wait"):
         assert name in phases, (name, phases)
         assert phases[name] >= 0.0
-    assert set(phases) - set(CRITICAL_PATH_PHASES) <= {"checkpoint_bg"}
+    # ...off-path extras are limited to the two concurrent-time buckets
+    # (background checkpoint write, ingest-pool parallel decode)
+    assert set(phases) - set(CRITICAL_PATH_PHASES) <= {
+        "checkpoint_bg", "decode_parallel",
+    }
     # ...and their sum is a decomposition of (bounded by) the run's wall
     crit = critical_path_seconds(phases)
     assert 0.0 < crit <= wall, (crit, wall)
@@ -486,3 +490,323 @@ def test_phase_timers_nested_self_time():
     snap = pt.snapshot()
     assert snap["checkpoint"] >= 0.03 - 1e-4, snap
     assert snap["checkpoint_bg"] >= 0.03 - 1e-4, snap
+
+
+# ---------------- parallel ingest engine (r9) ----------------
+
+
+class _RecordingMaster:
+    """Minimal master double for unit-testing abandon paths: records
+    ReportTaskResult payloads, answers nothing else."""
+
+    def __init__(self):
+        self.reports = []
+
+    def call(self, method, request):
+        assert method == "ReportTaskResult", method
+        self.reports.append(dict(request))
+        return {"accepted": True}
+
+
+def _task_of(reader, task_id, start, end):
+    from elasticdl_tpu.data.reader import Shard
+    from elasticdl_tpu.master.task_dispatcher import TASK_TRAINING, Task
+
+    shard = reader.sources()[0]
+    return Task(task_id, Shard(shard, start, end), TASK_TRAINING, 0)
+
+
+def test_parallel_prep_bit_identical_to_serial(tmp_path, devices):
+    """The tentpole contract: threaded shard decode reassembles to exactly
+    the serial path's [T, mb, ...] stack, tail records, and counts — on an
+    mb-unaligned shard, so ragged-tail masking and gradient weighting
+    cannot drift."""
+    from elasticdl_tpu.data.synthetic import generate as _gen
+
+    data = str(tmp_path / "ragged.rio")
+    _gen("mnist", data, 56)  # mb=16: 3 full minibatches + 8-record tail
+    reader = create_data_reader(data)
+    spec = load_model_spec(
+        "elasticdl_tpu.models", "mnist.model_spec", **MNIST_TINY
+    )
+    preps = {}
+    for label, threads in (("serial", 1), ("parallel", 4)):
+        config = JobConfig(
+            model_def="mnist.model_spec", training_data=data,
+            minibatch_size=16, ingest_threads=threads,
+        )
+        w = Worker(
+            config, _RecordingMaster(), reader,
+            worker_id=label, spec=spec, devices=devices,
+        )
+        preps[label] = w._prep_fused_host(_task_of(reader, 0, 0, 56))
+        if threads > 1:
+            assert w._ingest is not None and w._ingest.parallel
+    s, p = preps["serial"], preps["parallel"]
+    assert (s.total, s.n_full) == (p.total, p.n_full) == (56, 3)
+    assert list(s.tail) == list(p.tail) and len(p.tail) == 8
+    assert set(s.stacked) == set(p.stacked)
+    for k in s.stacked:
+        assert s.stacked[k].dtype == p.stacked[k].dtype
+        assert s.stacked[k].shape == p.stacked[k].shape == (3, 16) + (
+            s.stacked[k].shape[2:]
+        )
+        np.testing.assert_array_equal(s.stacked[k], p.stacked[k])
+
+
+def test_k_deep_prep_pipeline_matches_synchronous(tmp_path, devices):
+    """prep_depth=3 holds up to three leased tasks in concurrent prep; the
+    job must complete to the same step count as the synchronous path with
+    every task reported exactly once."""
+    results = {}
+    for label, flags in (
+        ("deep", dict(prep_depth=3, ingest_threads=2)),
+        ("synchronous", dict(task_pipelining=False)),
+    ):
+        config, servicer, reader, _, spec = _mnist_job(
+            tmp_path / label, num_epochs=2, **flags
+        )
+        worker = Worker(
+            config, DirectMasterProxy(servicer), reader,
+            worker_id="w0", spec=spec, devices=devices,
+        )
+        results[label] = (worker.run(), servicer, worker)
+    for label, (result, servicer, _w) in results.items():
+        assert result["step"] == 12, label  # 2 epochs x 6 steps
+        assert servicer.dispatcher.finished(), label
+        assert servicer.JobStatus({})["done"] == 6, label
+    deep_worker = results["deep"][2]
+    assert deep_worker._prep_pool is not None
+    assert deep_worker._prep_pool._max_workers == 3
+    assert not deep_worker._prep_queue  # job end drained every slot
+
+
+def test_k_deep_prep_abandon_reports_each_exactly_once(tmp_path, devices):
+    """Preemption containment for the k-deep queue: every queued prep is
+    failure-reported exactly once (immediate master requeue), futures are
+    settled or cancelled, a second abandon is a no-op, and no prep threads
+    leak beyond the bounded pool."""
+    import threading
+
+    data = str(tmp_path / "t.rio")
+    from elasticdl_tpu.data.synthetic import generate as _gen
+
+    _gen("mnist", data, 96)
+    reader = create_data_reader(data)
+    spec = load_model_spec(
+        "elasticdl_tpu.models", "mnist.model_spec", **MNIST_TINY
+    )
+    config = JobConfig(
+        model_def="mnist.model_spec", training_data=data,
+        minibatch_size=16, prep_depth=3,
+    )
+    master = _RecordingMaster()
+    # Other workers in this test process keep their own (bounded) pools
+    # alive; only THIS worker's thread growth is under test.
+    threads_before = {
+        t for t in threading.enumerate() if t.name.startswith("edl-prep")
+    }
+    w = Worker(config, master, reader, worker_id="w0", spec=spec,
+               devices=devices)
+    for i, (a, b) in enumerate(((0, 32), (32, 64), (64, 96))):
+        task = _task_of(reader, i, a, b)
+        report = {"worker_id": "w0", "task_id": i,
+                  "task_type": task.type, "success": True}
+        w._prep_queue.append((task, report, w._submit_prep(task)))
+    entries = list(w._prep_queue)
+    w._abandon_prep()
+    assert not w._prep_queue
+    assert sorted(r["task_id"] for r in master.reports) == [0, 1, 2]
+    assert all(r["success"] is False for r in master.reports)
+    w._abandon_prep()  # idempotent: nothing left to report
+    assert len(master.reports) == 3
+    # futures settle (run to completion or cancelled) — no orphaned work
+    for _task, _report, fut in entries:
+        fut.cancel()
+        fut.cancelled() or fut.result(timeout=30)
+    # bounded pool: this worker added at most prep_depth threads
+    new_threads = {
+        t for t in threading.enumerate() if t.name.startswith("edl-prep")
+    } - threads_before
+    assert len(new_threads) <= 3
+
+
+def test_abandon_leases_returns_tasks_and_group_mode_drops(tmp_path, devices):
+    """Unstarted lease buffer entries are failure-reported (requeue now,
+    not at timeout) in single-worker mode; in group mode the buffer is
+    lockstep-log read-ahead the master already invalidates, so it is
+    dropped without reports (a report would double-requeue)."""
+    data = str(tmp_path / "t.rio")
+    from elasticdl_tpu.data.synthetic import generate as _gen
+
+    _gen("mnist", data, 64)
+    reader = create_data_reader(data)
+    spec = load_model_spec(
+        "elasticdl_tpu.models", "mnist.model_spec", **MNIST_TINY
+    )
+    config = JobConfig(model_def="mnist.model_spec", training_data=data,
+                       minibatch_size=16)
+    master = _RecordingMaster()
+    w = Worker(config, master, reader, worker_id="w0", spec=spec,
+               devices=devices)
+    t0 = _task_of(reader, 7, 0, 32).to_dict()
+    t1 = _task_of(reader, 8, 32, 64).to_dict()
+    w._leased.extend(
+        {"task": t, "finished": False, "stale": False} for t in (t0, t1)
+    )
+    w._abandon_leases()
+    assert not w._leased
+    assert sorted(r["task_id"] for r in master.reports) == [7, 8]
+    assert all(r["success"] is False for r in master.reports)
+
+    # group mode: drop, never report
+    w._leased.append({"task": t0, "finished": False, "stale": False})
+    w._group_mode = True
+    w._abandon_leases()
+    assert not w._leased and len(master.reports) == 2
+
+
+def test_membership_change_drains_prep_and_returns_leases(tmp_path, devices):
+    """A membership change mid-run under the full r9 pipeline (k-deep prep,
+    batched leases): prepped tasks dispatch on the OLD mesh, buffered
+    leases go back to the master for immediate requeue, the mesh re-forms,
+    and the job completes with every shard trained exactly once."""
+    config, servicer, reader, _, spec = _mnist_job(
+        tmp_path, num_epochs=1, prep_depth=2,
+    )
+    worker = Worker(
+        config, DirectMasterProxy(servicer), reader,
+        worker_id="w0", spec=spec, devices=devices, devices_per_worker=4,
+    )
+    orig_get_task = servicer.GetTask
+    calls = {"n": 0}
+
+    def get_task_with_join(req):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            # Phantom joins during the FIRST (batched) lease: the version
+            # bump is noticed at the next heartbeat, while the prep queue
+            # and lease buffer still hold this batch's tasks.
+            servicer.rendezvous.register("phantom")
+        return orig_get_task(req)
+
+    servicer.GetTask = get_task_with_join
+    result = worker.run()
+    assert result["reforms"] == 1
+    assert servicer.dispatcher.finished()
+    status = servicer.JobStatus({})
+    assert status["done"] == 3 and status["todo"] == 0
+    assert result["step"] == 6  # nothing trained twice, nothing skipped
+    assert not worker._prep_queue and not worker._leased
+
+
+def test_eval_pending_heartbeat_returns_leases(tmp_path, devices):
+    """An eval_pending heartbeat makes a lease-holding worker return its
+    buffer (requeue-flagged, budget untouched) so the round is not delayed
+    by lease_batch-1 tasks of version skew."""
+    data = str(tmp_path / "t.rio")
+    generate("mnist", data, 64)
+    reader = create_data_reader(data)
+    spec = load_model_spec(
+        "elasticdl_tpu.models", "mnist.model_spec", **MNIST_TINY
+    )
+    config = JobConfig(model_def="mnist.model_spec", training_data=data,
+                       minibatch_size=16)
+
+    class HintingMaster:
+        def __init__(self):
+            self.reports = []
+
+        def call(self, method, request):
+            if method == "Heartbeat":
+                return {"version": -1, "eval_pending": True}
+            if method == "ReportTaskResult":
+                self.reports.append(dict(request))
+                return {"accepted": True}
+            raise AssertionError(method)
+
+    master = HintingMaster()
+    w = Worker(config, master, reader, worker_id="w0", spec=spec,
+               devices=devices)
+    t = _task_of(reader, 5, 0, 32).to_dict()
+    w._leased.append({"task": t, "finished": False, "stale": False})
+    w._check_membership()  # version matches (-1): no re-form, just the hint
+    assert not w._leased
+    assert [r["task_id"] for r in master.reports] == [5]
+    assert master.reports[0]["requeue"] is True
+
+
+def test_prep_pool_serializes_for_non_thread_safe_readers(tmp_path, devices):
+    """A reader that does not declare thread_safe_ranges keeps the one-
+    thread prep pool even at prep_depth>1 — concurrent _read_records calls
+    are exactly what such readers forbid (reader.py contract)."""
+    config, servicer, reader, _, spec = _mnist_job(
+        tmp_path, num_epochs=1, prep_depth=3
+    )
+
+    class OpaqueReader:  # no thread_safe_ranges attribute -> default False
+        def read_records(self, shard):
+            return reader.read_records(shard)
+
+    w = Worker(
+        config, DirectMasterProxy(servicer), OpaqueReader(),
+        worker_id="w0", spec=spec, devices=devices,
+    )
+    w._submit_prep(_task_of(reader, 0, 0, 32)).result(timeout=30)
+    assert w._prep_pool._max_workers == 1
+    # ...while a range-safe reader gets the full prep_depth width
+    config2, servicer2, reader2, _, spec2 = _mnist_job(
+        tmp_path / "safe", num_epochs=1, prep_depth=3
+    )
+    w2 = Worker(
+        config2, DirectMasterProxy(servicer2), reader2,
+        worker_id="w0", spec=spec2, devices=devices,
+    )
+    w2._submit_prep(_task_of(reader2, 0, 0, 32)).result(timeout=30)
+    assert w2._prep_pool._max_workers == 3
+
+
+def test_draining_heartbeat_returns_prep_queue_and_leases(tmp_path, devices):
+    """The max-steps draining hint returns BOTH the lease buffer and the
+    undispatched prep queue (no device work in either); the stopped
+    dispatcher drops them, so overshoot shrinks to dispatched tasks."""
+    data = str(tmp_path / "t.rio")
+    generate("mnist", data, 96)
+    reader = create_data_reader(data)
+    spec = load_model_spec(
+        "elasticdl_tpu.models", "mnist.model_spec", **MNIST_TINY
+    )
+    config = JobConfig(model_def="mnist.model_spec", training_data=data,
+                       minibatch_size=16, prep_depth=2)
+
+    class DrainingMaster:
+        def __init__(self):
+            self.reports = []
+
+        def call(self, method, request):
+            if method == "Heartbeat":
+                return {"version": -1, "draining": True}
+            if method == "ReportTaskResult":
+                self.reports.append(dict(request))
+                return {"accepted": True}
+            raise AssertionError(method)
+
+    master = DrainingMaster()
+    w = Worker(config, master, reader, worker_id="w0", spec=spec,
+               devices=devices)
+    t0 = _task_of(reader, 0, 0, 32)
+    w._prep_queue.append(
+        (t0, {"worker_id": "w0", "task_id": 0, "task_type": t0.type,
+              "success": True}, w._submit_prep(t0))
+    )
+    w._leased.append(
+        {"task": _task_of(reader, 1, 32, 64).to_dict(),
+         "finished": False, "stale": False}
+    )
+    w._check_membership()
+    assert not w._prep_queue and not w._leased
+    assert sorted(r["task_id"] for r in master.reports) == [0, 1]
+    assert all(
+        r["requeue"] is True and r["success"] is False
+        for r in master.reports
+    )

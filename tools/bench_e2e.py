@@ -159,6 +159,8 @@ def run_e2e(log=lambda msg: None) -> dict:
     elapsed = reports[-1] - reports[WARM_TASKS - 1]
     examples = measured * RECORDS_PER_TASK
     n_chips = len(jax.devices())
+    from elasticdl_tpu.data.ingest_pool import resolve_threads
+
     return {
         "e2e_examples_per_sec_per_chip": examples / elapsed / n_chips,
         "tasks_measured": measured,
@@ -168,6 +170,12 @@ def run_e2e(log=lambda msg: None) -> dict:
         "steps": result["step"],
         "warm_tasks_excluded": WARM_TASKS,
         **link,
+        # Pipeline config (r9): e2e numbers are only comparable at equal
+        # ingest/prep/lease shape, exactly like the link fields above —
+        # bench.py's record guard enforces it.
+        "ingest_threads": resolve_threads(config.ingest_threads),
+        "prep_depth": config.prep_depth,
+        "lease_batch": config.lease_batch,
     }
 
 

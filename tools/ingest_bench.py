@@ -13,7 +13,14 @@ Pure host work — runs identically on the CPU harness and the TPU host.
 Writes ONE JSON artifact (default ``artifacts/ingest_stages_r05.json``);
 docs/perf.md quotes the file.
 
-Usage: python tools/ingest_bench.py [--out PATH]
+``--threads N`` (r9) switches to the parallel-ingest sweep: the worker's
+chunked read+decode path (data/ingest_pool.py — minibatch-aligned
+sub-chunks, bulk C++ range read + preprocessed criteo decode per chunk,
+ordered reassembly) measured at pool widths 1, 2, ..., N (powers of two
+plus N), reporting host-side examples/sec and speedup vs the 1-thread
+serial path.  Artifact: ``artifacts/INGEST_r09.json``.
+
+Usage: python tools/ingest_bench.py [--threads N] [--out PATH]
 """
 
 from __future__ import annotations
@@ -54,14 +61,101 @@ def _wire_bytes(batch: dict) -> int:
     return sum(np.asarray(v).nbytes for v in batch.values())
 
 
+def _thread_sweep(max_threads: int, out: str, log) -> None:
+    """Parallel-ingest sweep: the worker's chunked read+decode at pool
+    widths 1..max_threads over task-sized ranges (the e2e shard size), with
+    per-width examples/sec and speedup vs serial.  Mirrors
+    Worker._prep_fused_host's chunk plan exactly (minibatch-aligned spans,
+    read_records_packed + criteo_feed_pre per chunk) minus the stacking,
+    so the number is comparable to the r5 ``host_side_examples_per_sec``."""
+    from elasticdl_tpu.data.ingest_pool import IngestPool, plan_chunks
+    from elasticdl_tpu.data.codecs import criteo_feed_pre
+    from elasticdl_tpu.data.reader import Shard, create_data_reader
+    from tools.bench_e2e import _dataset
+
+    task_records = MINIBATCH * 8  # the e2e bench's records-per-task
+    path = _dataset()
+    reader = create_data_reader(path)
+    log(f"dataset {path} ({os.path.getsize(path) >> 20} MiB), "
+        f"{task_records}-record tasks, host cores: {os.cpu_count()}")
+
+    widths = sorted({1, *(
+        w for w in (2, 4, 8, 16) if w < max_threads
+    ), max_threads})
+    n_tasks = 8
+    rows = []
+    for width in widths:
+        pool = IngestPool(width)
+
+        def _decode_chunk(span):
+            recs = reader.read_records_packed(
+                Shard(path, span[0], span[1])
+            )
+            return criteo_feed_pre(recs, BUCKETS)
+
+        best = float("inf")
+        for _ in range(REPEATS):
+            t0 = time.perf_counter()
+            for b in range(n_tasks):
+                start = b * task_records
+                chunks = plan_chunks(
+                    start, start + task_records, MINIBATCH, pool.threads
+                )
+                pool.map_ordered(_decode_chunk, chunks)
+            best = min(best, time.perf_counter() - t0)
+        pool.shutdown()
+        eps = task_records * n_tasks / best
+        rows.append({
+            "threads": width,
+            "examples_per_sec": round(eps, 1),
+            "ms_per_task": round(best / n_tasks * 1e3, 3),
+        })
+        log(f"threads={width}: {eps:,.0f} examples/sec host-side")
+    base = rows[0]["examples_per_sec"]
+    for r in rows:
+        r["speedup_vs_1"] = round(r["examples_per_sec"] / base, 3)
+    artifact = {
+        "metric": "parallel_ingest_host_examples_per_sec",
+        "unit": f"examples/sec, {task_records}-record criteo tasks "
+                f"(read_records_packed + criteo_feed_pre per chunk, best "
+                f"of {REPEATS} x {n_tasks} tasks)",
+        "host_cpu_count": os.cpu_count(),
+        "sweep": rows,
+        "note": "speedup ceiling is min(threads, host cores): the chunk "
+                "decode is CPU-bound GIL-releasing C++, so a 2-core "
+                "harness tops out near 2x regardless of pool width",
+    }
+    from tools.artifact import write_artifact
+
+    write_artifact(artifact, "INGEST_r09.json", path=out, log=log)
+    print(json.dumps(rows), flush=True)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument(
-        "--out", default=os.path.join(_REPO_ROOT, "artifacts",
-                                      "ingest_stages_r05.json")
+        "--out", default=""
+    )
+    ap.add_argument(
+        "--threads", type=int, default=0,
+        help="run the parallel-ingest sweep up to this pool width "
+             "(stamps artifacts/INGEST_r09.json) instead of the serial "
+             "stage breakdown",
     )
     args = ap.parse_args()
     log = lambda m: print(f"[ingest] {m}", file=sys.stderr, flush=True)
+
+    if args.threads > 0:
+        _thread_sweep(
+            args.threads,
+            args.out or os.path.join(_REPO_ROOT, "artifacts",
+                                     "INGEST_r09.json"),
+            log,
+        )
+        return
+    args.out = args.out or os.path.join(
+        _REPO_ROOT, "artifacts", "ingest_stages_r05.json"
+    )
 
     from elasticdl_tpu.data.codecs import criteo_feed, criteo_feed_pre
     from elasticdl_tpu.data.reader import Shard, create_data_reader

@@ -30,7 +30,7 @@ Two modes:
 
 Writes ONE JSON artifact per mode (the number of record — docs/perf.md
 quotes the file): ``artifacts/multiworker_r05.json`` /
-``artifacts/gang_ingest_r06.json`` by default.
+``artifacts/gang_ingest_r09.json`` by default.
 
 Usage: python tools/multiworker_bench.py [--mode control|ingest]
            [--fleets 1,2,4] [--tasks 96] [--platform cpu|chip]
@@ -391,6 +391,18 @@ def main() -> None:
                     "figure does not scale with fleet size, it must "
                     "HOLD as the gang grows",
         }
+        # Pipeline shape (r9): the workers run JobConfig defaults for the
+        # ingest/prep/lease knobs; numbers are only comparable at equal
+        # shape (same rule as bench.py's record guard).
+        from elasticdl_tpu.common.config import JobConfig
+        from elasticdl_tpu.data.ingest_pool import resolve_threads
+
+        _cfg = JobConfig()
+        artifact["pipeline"] = {
+            "ingest_threads": resolve_threads(_cfg.ingest_threads),
+            "prep_depth": _cfg.prep_depth,
+            "lease_batch": _cfg.lease_batch,
+        }
         from tools.artifact import write_artifact
 
         if args.platform == "chip":
@@ -404,7 +416,7 @@ def main() -> None:
             else:
                 os.environ.pop("JAX_PLATFORMS", None)
         write_artifact(
-            artifact, "gang_ingest_r06.json", env_var="GANG_INGEST_OUT",
+            artifact, "gang_ingest_r09.json", env_var="GANG_INGEST_OUT",
             path=args.out or None, log=log,
         )
         print(json.dumps(artifact["fleets"]), flush=True)

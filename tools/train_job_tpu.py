@@ -155,7 +155,8 @@ def main() -> None:
         "warm_tasks_excluded": warm,
         "checkpoint_steps_on_disk": ckpt_steps,
         # prep_wait / dispatch / step_wait / metrics / checkpoint / control
-        # (+ off-path checkpoint_bg) — see common/metrics.py PhaseTimers.
+        # / lease_wait (+ off-path checkpoint_bg, decode_parallel) — see
+        # common/metrics.py PhaseTimers.
         "phase_times": phase_summary,
         "stack": "Master(gRPC)+ProcessPodBackend worker on TPU, recordio "
                  "input via C++ bulk reader + preprocessing codec, "
