@@ -12,8 +12,10 @@ topology-agnostic, the restore target's shardings belong to the new mesh.
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Any, Optional
+import time
+from typing import Any, Dict, Optional
 
 import jax
 import orbax.checkpoint as ocp
@@ -21,6 +23,61 @@ import orbax.checkpoint as ocp
 from elasticdl_tpu.common.log_utils import get_logger
 
 logger = get_logger("checkpoint")
+
+#: The published-checkpoint manifest: a tiny JSON file next to the Orbax
+#: step dirs naming the newest step whose save (dense state AND host-store
+#: shards) is COMPLETE.  The serving tier's checkpoint watcher keys off this
+#: file — never off directory listings, which show steps mid-write.
+MANIFEST_NAME = "checkpoint_manifest.json"
+
+
+def publish_manifest(
+    directory: str,
+    step: int,
+    code_rev: str = "",
+    extra: Optional[Dict[str, Any]] = None,
+) -> str:
+    """Atomically publish ``step`` as the newest complete checkpoint.
+
+    Write-to-temp + ``os.replace``: a reader (the serving watcher, possibly
+    in another process) sees either the previous manifest or the new one,
+    never a half-written file — the same commit idiom as the PS shard
+    snapshots (ps/service.PSServer._save).  The caller must only publish
+    AFTER the checkpoint itself is fully committed (Orbax wait + host-store
+    snapshot): the manifest is the happens-after edge serving relies on.
+    """
+    path = os.path.join(directory, MANIFEST_NAME)
+    os.makedirs(directory, exist_ok=True)
+    payload = {
+        "step": int(step),
+        "code_rev": code_rev,
+        "published_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    if extra:
+        payload.update(extra)
+    tmp = path + f".tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def read_manifest(directory: str) -> Optional[Dict[str, Any]]:
+    """The published manifest, or None when absent/unreadable.  Tolerant by
+    design: a missing or garbage manifest means "nothing published yet",
+    not an error — fresh checkpoint dirs and pre-manifest checkpoints both
+    look that way."""
+    path = os.path.join(directory, MANIFEST_NAME)
+    try:
+        with open(path) as f:
+            m = json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError, OSError):
+        return None
+    if not isinstance(m, dict) or not isinstance(m.get("step"), int):
+        return None
+    return m
 
 
 class CheckpointManager:
@@ -54,6 +111,20 @@ class CheckpointManager:
             state_like,
         )
         return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+
+    def publish(
+        self,
+        step: int,
+        code_rev: str = "",
+        extra: Optional[Dict[str, Any]] = None,
+    ) -> str:
+        """Publish ``step`` for online consumers (the serving watcher) —
+        AFTER draining any in-flight async save, so the manifest can never
+        name a step Orbax has not finished committing.  Host-store snapshots
+        must already be on disk when this is called (the worker save paths
+        order it last)."""
+        self._mgr.wait_until_finished()
+        return publish_manifest(self.directory, step, code_rev=code_rev, extra=extra)
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()

@@ -191,6 +191,14 @@ class _SanLock:
     def __exit__(self, *exc) -> None:
         self.release()
 
+    def _is_owned(self) -> bool:
+        """``threading.Condition`` compatibility: Condition(lock) prefers the
+        lock's own ``_is_owned`` when present.  Without this, Condition's
+        fallback probes ownership via a non-blocking re-``acquire`` — which
+        the sanitizer (correctly) rejects as a self-deadlock before the probe
+        can return False.  Answer from the per-thread held stack instead."""
+        return any(h is self for h in _held())
+
     def locked(self) -> bool:
         # RLock grew .locked() only in 3.12; absent there, report via the
         # held bookkeeping (callers in this repo only probe plain Locks).

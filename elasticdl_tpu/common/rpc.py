@@ -116,6 +116,23 @@ MASTER_SCHEMAS: Dict[str, MessageSchema] = {
 }
 
 
+SERVING_SERVICE_NAME = "elasticdl.Serving"
+
+#: The serving tier's wire contract (serving/server.py's method table —
+#: asserted in lockstep by tests, like MASTER_SCHEMAS above).  Feature
+#: values ride as JSON lists: online requests are a handful of examples, so
+#: JSON's ~4x float inflation is noise here (the bulk-tensor path that
+#: justified the PS tier's binary frames moves 6.8 MB pulls; a Predict
+#: moves tens of floats).
+SERVING_SCHEMAS: Dict[str, MessageSchema] = {
+    # features: {feature_name: nested list}, shaped per the model's feature
+    # template (ModelInfo reports it).  A single example may omit the
+    # leading batch dim; multi-example requests carry it.
+    "Predict": MessageSchema(required={"features": _DICT}),
+    "ModelInfo": MessageSchema(),
+}
+
+
 class SchemaError(ValueError):
     """A message violated its method's schema (the structured boundary error)."""
 

@@ -161,6 +161,12 @@ def _apply(
     return first + fm + deep
 
 
+def _predict(params, batch, ctx: ParallelContext = ParallelContext(), **kw):
+    """Inference entry (serving tier / predict jobs): click probability in
+    [0, 1], not the raw logit — what an online caller actually consumes."""
+    return jax.nn.sigmoid(_apply(params, batch, train=False, ctx=ctx, **kw))
+
+
 def _loss(logits, batch, mask=None):
     return bce_loss(logits, batch["labels"], mask)
 
@@ -238,6 +244,12 @@ def model_spec(
         ),
         apply=functools.partial(
             _apply,
+            buckets_per_feature=buckets_per_feature,
+            embedding_dim=dim,
+            compute_dtype=dtype,
+        ),
+        predict=functools.partial(
+            _predict,
             buckets_per_feature=buckets_per_feature,
             embedding_dim=dim,
             compute_dtype=dtype,

@@ -78,6 +78,14 @@ def _loss(logits, batch, mask=None):
     return masked_mean(ce, mask)
 
 
+def _predict(params, batch, compute_dtype=jnp.bfloat16, **_):
+    """Inference entry (serving tier / predict jobs): class probabilities
+    [b, 10] rather than raw logits."""
+    return jax.nn.softmax(
+        _apply(params, batch, train=False, compute_dtype=compute_dtype), axis=-1
+    )
+
+
 def _metrics(logits, batch, mask=None) -> Dict[str, Any]:
     from elasticdl_tpu.models.metrics import masked_mean
 
@@ -104,6 +112,7 @@ def model_spec(learning_rate: float = 1e-3, compute_dtype: str = "bfloat16") -> 
         name="mnist",
         init=functools.partial(_init_params, compute_dtype=dtype),
         apply=functools.partial(_apply, compute_dtype=dtype),
+        predict=functools.partial(_predict, compute_dtype=dtype),
         loss=_loss,
         metrics=_metrics,
         optimizer=optax.sgd(learning_rate, momentum=0.9),

@@ -12,6 +12,8 @@ functions over pytrees so the whole step jits:
 - ``metrics(out, batch) -> dict``         ~ eval_metrics_fn
 - ``optimizer``                           ~ optimizer (optax)
 - ``feed(records) -> batch``              ~ feed / dataset_fn
+- ``predict(params, batch) -> outputs``   ~ predict-mode / serving outputs
+  (client-ready values, e.g. probabilities; defaults to apply(train=False))
 - ``embedding_tables``                    ~ elasticdl.layers.Embedding usage:
   names of params that are sparse embedding tables, which the
   ParameterServer strategy shards row-wise over the mesh.
@@ -99,6 +101,13 @@ class ModelSpec:
     batch_shard_dim: int = 0
     # Example batch (tiny) for compile checks / shape inference.
     example_batch: Optional[Callable[[int], Batch]] = None
+    # Inference entry point (the serving tier's forward, and predict-mode
+    # jobs): ``(params, batch, ctx=...) -> per-example outputs`` ready for a
+    # client — e.g. sigmoid probability for the binary tabular models,
+    # class probabilities for mnist — instead of raw training logits.
+    # None = serve ``apply(params, batch, train=False)`` outputs as-is.
+    # Jitted inside build_predict_step, so the transform is free on device.
+    predict: Optional[Callable[..., Any]] = None
 
 
 def load_model_spec(model_zoo: str, model_def: str, **params: Any) -> ModelSpec:

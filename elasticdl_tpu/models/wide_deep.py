@@ -120,6 +120,12 @@ def _apply(
     return wide + deep + params["bias"][0]
 
 
+def _predict(params, batch, ctx: ParallelContext = ParallelContext(), **kw):
+    """Inference entry (serving tier / predict jobs): income-bracket
+    probability in [0, 1] rather than the raw logit."""
+    return jax.nn.sigmoid(_apply(params, batch, train=False, ctx=ctx, **kw))
+
+
 def _loss(logits, batch, mask=None):
     return bce_loss(logits, batch["labels"], mask)
 
@@ -155,6 +161,9 @@ def model_spec(
         ),
         apply=functools.partial(
             _apply, buckets=buckets, embedding_dim=embedding_dim, compute_dtype=dtype
+        ),
+        predict=functools.partial(
+            _predict, buckets=buckets, embedding_dim=embedding_dim, compute_dtype=dtype
         ),
         loss=_loss,
         metrics=_metrics,

@@ -779,6 +779,16 @@ class Trainer:
             ) from e
         return True
 
+    def wrap_host_stores(self, wrap) -> None:
+        """Layer a decorator over every host-tier store — the serving tier
+        interposes its hot-id LRU cache this way (serving/embedding_cache).
+        ``wrap(key, store)`` must return a pull-compatible object (same
+        ``pull``/``dim`` surface); training paths additionally need
+        ``push_grad``/``save``/``load`` if they run through the wrapper."""
+        self._host_stores = {
+            key: wrap(key, store) for key, store in self._host_stores.items()
+        }
+
     # ---- step builders ----
 
     # Built steps cache by the BATCH TREE STRUCTURE, not just lazily once:
@@ -1035,11 +1045,20 @@ def build_predict_step(
     batch_axes: Optional[Tuple[str, ...]] = None,
 ) -> Callable:
     """Per-example model outputs, batch-sharded in and out (the reference's
-    predict mode, SURVEY.md §2 #1 'predict')."""
+    predict mode, SURVEY.md §2 #1 'predict').  Models with a ``predict``
+    entry (models/spec.ModelSpec.predict) serve client-ready values (e.g.
+    probabilities); the rest serve raw ``apply(train=False)`` outputs."""
     axis = ctx.axis_name
     assert axis is not None
 
     def local_predict(state: TrainState, batch):
+        # Serving batches ride with a padding mask the model must not see
+        # (``__mask__`` is the micro-batcher's fan-back bookkeeping) —
+        # mirror local_eval's pop.
+        batch = dict(batch)
+        batch.pop("__mask__", None)
+        if spec.predict is not None:
+            return spec.predict(state.params, batch, ctx=ctx)
         return spec.apply(state.params, batch, train=False, ctx=ctx)
 
     d = spec.batch_shard_dim

@@ -1,0 +1,14 @@
+"""Online serving tier (r10): micro-batched inference RPC over the PS host
+store, with hot-id embedding caching and zero-drop checkpoint hot reload.
+
+Import surfaces are deliberately split so control-plane/bench processes can
+dial the service without paying a jax import:
+
+- jax-free: ``serving.client`` (ServingClient), ``serving.micro_batcher``,
+  ``serving.embedding_cache``.
+- jax-bound: ``serving.server`` (ServingServer — owns the jitted forward),
+  ``serving.checkpoint_watcher`` (reads manifests via common/checkpoint).
+
+This package namespace stays import-light on purpose: import the module
+you need, not the package surface.
+"""

@@ -1,0 +1,92 @@
+"""Checkpoint watcher — the serving tier's hot-reload trigger.
+
+Polls the training job's published-checkpoint manifest
+(``common/checkpoint.publish_manifest``: written by temp + atomic rename
+AFTER the Orbax commit and host-store snapshot are both complete) and
+invokes ``on_new_step(step, manifest)`` whenever the published step
+changes.  Keying off the manifest — never directory listings — is what
+makes a reload safe: a step directory exists from the moment Orbax starts
+writing it, but the manifest names it only once it is whole, so the watcher
+can never hand the server a half-written checkpoint.
+
+The callback runs on the watcher thread; the server's reload
+(serving/server.py) does the expensive restore there, CONCURRENT with
+serving, and only the final reference swap touches the live path.  A
+failing callback is logged and retried at the poll cadence — a torn
+volume or transient read error must not kill the watcher (the next
+publish, or the next poll, gets another chance).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional
+
+from elasticdl_tpu.common.checkpoint import read_manifest
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("serving.ckpt_watcher")
+
+
+class CheckpointWatcher:
+    """Manifest poller: ``on_new_step(step, manifest)`` per published change.
+
+    Any CHANGE of the published step triggers — including a step going
+    backwards (a training job restarted from an older checkpoint publishes
+    an older step; the serving tier must follow its source of truth, not
+    ratchet forward onto weights the trainer abandoned)."""
+
+    def __init__(
+        self,
+        directory: str,
+        on_new_step: Callable[[int, Dict[str, Any]], None],
+        poll_interval_s: float = 0.5,
+        name: str = "serving",
+        initial_step: Optional[int] = None,
+    ):
+        self.directory = directory
+        self.poll_interval_s = poll_interval_s
+        self._on_new_step = on_new_step
+        # initial_step: the step the server already loaded at startup, so
+        # the first poll does not redundantly re-apply it.
+        self._applied: Optional[int] = initial_step  # watcher/poke threads only
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"edl-ckpt-watch:{name}", daemon=True
+        )
+
+    def start(self) -> "CheckpointWatcher":
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            self.poke()
+
+    def poke(self) -> bool:
+        """One synchronous poll (the loop body; also the deterministic
+        test/bench hook).  True when a new step was applied."""
+        m = read_manifest(self.directory)
+        if m is None or m["step"] == self._applied:
+            return False
+        step = int(m["step"])
+        try:
+            self._on_new_step(step, m)
+        except Exception:
+            logger.exception(
+                "hot reload to step %d failed; retrying at the poll cadence",
+                step,
+            )
+            return False
+        self._applied = step
+        logger.info("hot reload applied: serving checkpoint step %d", step)
+        return True
+
+    def applied_step(self) -> Optional[int]:
+        """The step the last successful reload applied (None = none yet)."""
+        return self._applied
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout_s)

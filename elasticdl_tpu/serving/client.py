@@ -1,0 +1,68 @@
+"""Typed client for the serving tier (serving/server.ServingServer).
+
+Deliberately jax-free and numpy-light: an online caller (a web frontend, a
+bench driver) dials the prediction service with plain feature lists; the
+client validates against SERVING_SCHEMAS before the wire, mirroring
+JsonRpcClient's boundary contract for the master service.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from elasticdl_tpu.common.rpc import (
+    SERVING_SCHEMAS,
+    SERVING_SERVICE_NAME,
+    JsonRpcClient,
+)
+
+
+def _jsonable(value: Any) -> Any:
+    """Feature value -> JSON-serializable nested lists (numpy arrays and
+    scalars included; python lists pass through)."""
+    if isinstance(value, np.ndarray):
+        return value.tolist()
+    if isinstance(value, (np.generic,)):
+        return value.item()
+    return value
+
+
+class ServingClient:
+    """Blocking Predict/ModelInfo calls to one serving replica."""
+
+    def __init__(self, address: str):
+        self.address = address
+        self._rpc = JsonRpcClient(
+            address, SERVING_SERVICE_NAME, schemas=SERVING_SCHEMAS
+        )
+
+    def wait_ready(self, timeout_s: float = 10.0) -> None:
+        self._rpc.wait_ready(timeout_s)
+
+    # hot-path: the caller-side request — serialize, one RPC, done
+    def predict(
+        self, features: Dict[str, Any], timeout_s: float = 30.0
+    ) -> Dict[str, Any]:
+        """``features``: {name: array-like} per the model's feature template
+        (ModelInfo reports dtypes/shapes; a single example may omit the
+        batch dim).  Returns {"outputs": nested lists, "model": name,
+        "step": serving checkpoint step}."""
+        # graftlint: allow[blocking-propagation] _jsonable's .item() is numpy-scalar unboxing, not a device read — this client is jax-free by design
+        payload = {k: _jsonable(v) for k, v in features.items()}
+        return self._rpc.call(
+            "Predict", {"features": payload}, timeout_s=timeout_s
+        )
+
+    def predict_outputs(
+        self, features: Dict[str, Any], timeout_s: float = 30.0
+    ) -> np.ndarray:
+        """predict() with the outputs as a numpy array (the common case)."""
+        return np.asarray(self.predict(features, timeout_s)["outputs"])
+
+    def model_info(self, timeout_s: float = 10.0) -> Dict[str, Any]:
+        return self._rpc.call("ModelInfo", {}, timeout_s=timeout_s)
+
+    def close(self) -> None:
+        self._rpc.close()

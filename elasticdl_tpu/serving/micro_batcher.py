@@ -1,0 +1,343 @@
+"""Micro-batching for the online serving tier.
+
+Concurrent single-example ``Predict`` RPCs are individually far too small to
+feed a jitted forward efficiently — but the r9 lease work proved the repo's
+amortization move: batch many small requests into ONE hot-path crossing.
+This module is that move for inference.  gRPC handler threads ``submit()``
+their examples; a flusher thread coalesces them into ONE fixed-shape padded
+batch and runs the jitted forward once, then fans each request's slice of
+the outputs back to its waiting handler.
+
+Flush policy — deadline-or-full:
+
+- **full**: queued examples fill ``max_batch`` (or the next request would
+  overflow it) -> flush immediately; under load the batcher converges to
+  back-to-back full batches and per-request latency ~= one forward.
+- **deadline**: the OLDEST queued request has waited ``max_delay_ms`` ->
+  flush whatever is queued; under light load a lone request pays at most
+  the deadline plus one forward, never an unbounded wait for company.
+
+Every flush pads to exactly ``max_batch`` rows (zero rows, ``__mask__``
+marking the real ones) so the jitted forward compiles ONCE — a varying
+batch dimension would recompile per distinct size, and XLA compiles are
+milliseconds-to-seconds, i.e. death on a latency SLO.
+
+The runner executes in the flusher thread and is HANDED the current model
+snapshot by the server (serving/server.py) — requests in flight during a
+hot reload keep the weights they started with; the swap is a reference
+assignment, never a drain.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from elasticdl_tpu.common import locksan
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("serving.micro_batcher")
+
+MASK_KEY = "__mask__"
+
+
+class BatcherClosed(RuntimeError):
+    """submit() after close(): the server is shutting down."""
+
+
+class BatcherOverloaded(RuntimeError):
+    """submit() with the queue at its row bound: the replica is past its
+    knee — shed THIS request now (the caller sees a fast structured error)
+    instead of queueing it into a wait it cannot survive."""
+
+
+class PredictionHandle:
+    """One request's slot in a future flush: the handler thread parks on
+    ``result()`` until the flusher fans the outputs back."""
+
+    __slots__ = ("count", "features", "arrival", "_event", "_outputs",
+                 "_meta", "_error")
+
+    def __init__(self, count: int, features: Dict[str, np.ndarray],
+                 arrival: float):
+        self.count = count
+        self.features = features
+        self.arrival = arrival
+        self._event = threading.Event()
+        self._outputs: Any = None
+        self._meta: Dict[str, Any] = {}
+        self._error: Optional[BaseException] = None
+
+    def _resolve(self, outputs: Any, meta: Dict[str, Any]) -> None:
+        self._outputs = outputs
+        self._meta = meta
+        self._event.set()
+
+    def _fail(self, error: BaseException) -> None:
+        self._error = error
+        self._event.set()
+
+    def result(self, timeout_s: float = 30.0) -> Tuple[Any, Dict[str, Any]]:
+        """(outputs sliced to this request's rows, flush metadata).  Raises
+        the runner's error, or TimeoutError when no flush resolved us."""
+        if not self._event.wait(timeout_s):
+            raise TimeoutError(
+                f"prediction not served within {timeout_s}s "
+                "(flusher wedged or overloaded)"
+            )
+        if self._error is not None:
+            raise self._error
+        return self._outputs, self._meta
+
+
+def _slice_outputs(outputs: Any, lo: int, hi: int) -> Any:
+    """Per-request view of the flush outputs: arrays slice on the leading
+    (example) dim; dicts slice leaf-wise — covers every model-zoo output
+    shape without a jax dependency."""
+    if isinstance(outputs, dict):
+        return {k: _slice_outputs(v, lo, hi) for k, v in outputs.items()}
+    return np.asarray(outputs)[lo:hi]
+
+
+class MicroBatcher:
+    """Deadline-or-full request coalescing in front of a batch runner.
+
+    ``runner(batch, n_real) -> (outputs, meta)``: ``batch`` is a dict of
+    numpy arrays padded to ``max_batch`` rows (plus ``__mask__`` f32
+    [max_batch], 1.0 on real rows); outputs must keep the leading example
+    dim; ``meta`` is attached to every request of the flush (the server
+    stamps the serving model step).  Runs on the flusher thread — blocking
+    there is the design (it IS the accounted inference), which is why the
+    runner is not a ``# hot-path`` function but ``submit`` is.
+    """
+
+    def __init__(
+        self,
+        runner: Callable[[Dict[str, np.ndarray], int], Tuple[Any, Dict]],
+        template: Dict[str, np.ndarray],
+        max_batch: int = 64,
+        max_delay_ms: float = 5.0,
+        name: str = "serving",
+        max_queue_rows: Optional[int] = None,
+        drop_after_s: float = 30.0,
+    ):
+        """Overload policy (sustained load past the replica's knee):
+
+        - ``max_queue_rows`` (default 32 * max_batch): submit() sheds with
+          :class:`BatcherOverloaded` once the queue holds this many rows —
+          a fast structured error beats queueing into a wait the request
+          cannot survive, and it bounds queue memory.
+        - ``drop_after_s`` (default 30.0, matching ``PredictionHandle.
+          result``'s timeout): a queued request older than this at flush
+          time fails with TimeoutError instead of occupying flush slots —
+          its handler already gave up, and running a padded forward for
+          nobody would deepen the very backlog that expired it.
+        """
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self._runner = runner
+        # Per-feature zero rows at the padded batch shape: built once, so a
+        # flush only copies request rows in (no per-flush allocation of the
+        # template itself — padded buffers are fresh per flush, the model
+        # may donate them).
+        self._template = {
+            k: np.zeros((max_batch,) + tuple(np.asarray(v).shape[1:]),
+                        np.asarray(v).dtype)
+            for k, v in template.items()
+        }
+        self.max_batch = max_batch
+        self.max_delay_s = max_delay_ms / 1e3
+        self.max_queue_rows = (
+            max_queue_rows if max_queue_rows is not None else 32 * max_batch
+        )
+        self.drop_after_s = drop_after_s
+        self._lock = locksan.lock("MicroBatcher._lock", leaf=True)  # lock-order: leaf
+        self._cond = threading.Condition(self._lock)
+        self._queue: List[PredictionHandle] = []  # guarded-by: _cond
+        self._queued_rows = 0  # guarded-by: _cond
+        self._closed = False  # guarded-by: _cond
+        # Counters (stats()): mutated only under the condition lock.
+        self._submitted = 0  # guarded-by: _cond
+        self._flushes_full = 0  # guarded-by: _cond
+        self._flushes_deadline = 0  # guarded-by: _cond
+        self._flushes_close = 0  # guarded-by: _cond
+        self._rows_served = 0  # guarded-by: _cond
+        self._rows_padded = 0  # guarded-by: _cond
+        self._shed = 0  # guarded-by: _cond
+        self._expired = 0  # guarded-by: _cond
+        self._thread = threading.Thread(
+            target=self._flush_loop, name=f"edl-serve-flush:{name}", daemon=True
+        )
+        self._thread.start()
+
+    # -- request side --
+
+    # hot-path: the per-request enqueue on the serving critical path — one
+    # lock hand-off and a notify, never a device touch or an RPC
+    def submit(self, features: Dict[str, np.ndarray]) -> PredictionHandle:
+        """Queue ``features`` (dict of [n, ...] arrays covering the template
+        keys, consistent leading dim 1 <= n <= max_batch) for the next
+        flush.  Validation is exhaustive HERE, in the offender's own stack
+        frame: a malformed request that only failed during batch assembly
+        would fan its error to every innocent request co-batched with it."""
+        missing = [k for k in self._template if k not in features]
+        if missing:
+            raise ValueError(f"request missing feature(s) {missing}")
+        arrays: Dict[str, np.ndarray] = {}
+        n = None
+        for k, tmpl in self._template.items():
+            arr = np.asarray(features[k], tmpl.dtype)
+            if arr.shape[1:] != tmpl.shape[1:]:
+                raise ValueError(
+                    f"feature {k!r} has shape {arr.shape}, expected "
+                    f"[n, ...] with trailing dims {tmpl.shape[1:]}"
+                )
+            if n is None:
+                n = arr.shape[0] if arr.ndim else 0
+            elif arr.shape[0] != n:
+                raise ValueError(
+                    f"feature {k!r} carries {arr.shape[0]} examples, "
+                    f"earlier features carry {n}"
+                )
+            arrays[k] = arr
+        if not 1 <= (n or 0) <= self.max_batch:
+            raise ValueError(
+                f"request carries {n} examples; must be 1..{self.max_batch} "
+                "(split larger requests client-side)"
+            )
+        handle = PredictionHandle(n, arrays, time.monotonic())
+        with self._cond:
+            if self._closed:
+                raise BatcherClosed("micro-batcher is closed")
+            if self._queued_rows + n > self.max_queue_rows:
+                self._shed += 1
+                raise BatcherOverloaded(
+                    f"queue holds {self._queued_rows} rows (bound "
+                    f"{self.max_queue_rows}); shedding — the replica is "
+                    "past its knee, add replicas or lower the offered load"
+                )
+            self._queue.append(handle)
+            self._queued_rows += n
+            self._submitted += 1
+            self._cond.notify()
+        return handle
+
+    # -- flusher side --
+
+    def _take_locked(self) -> Tuple[List[PredictionHandle], str]:  # guarded-by: _cond
+        """(requests to flush now, reason) or ([], "") to keep waiting.
+        Whole requests only — a request never splits across flushes, so its
+        outputs fan back from exactly one runner call."""
+        # Shed expired requests (queued longer than drop_after_s — their
+        # handlers have already timed out): running a forward for nobody
+        # would deepen the backlog that expired them.  Arrival-ordered, so
+        # the expired set is a prefix.
+        now = time.monotonic()
+        while self._queue and now - self._queue[0].arrival > self.drop_after_s:
+            h = self._queue.pop(0)
+            self._queued_rows -= h.count
+            self._expired += 1
+            h._fail(TimeoutError(
+                f"request expired after {self.drop_after_s}s in the serving "
+                "queue (replica overloaded)"
+            ))
+        if not self._queue:
+            return [], ""
+        take: List[PredictionHandle] = []
+        rows = 0
+        overflow = False
+        for h in self._queue:
+            if rows + h.count > self.max_batch:
+                overflow = True
+                break
+            take.append(h)
+            rows += h.count
+        if rows == self.max_batch or overflow:
+            return take, "full"
+        if self._closed:
+            return take, "close"
+        oldest = self._queue[0].arrival
+        if time.monotonic() - oldest >= self.max_delay_s:
+            return take, "deadline"
+        return [], ""
+
+    def _flush_loop(self) -> None:
+        while True:
+            with self._cond:
+                take, reason = self._take_locked()
+                while not take:
+                    if self._closed and not self._queue:
+                        return
+                    if self._queue:
+                        # Sleep exactly to the oldest request's deadline.
+                        remaining = (
+                            self._queue[0].arrival + self.max_delay_s
+                            - time.monotonic()
+                        )
+                        self._cond.wait(max(remaining, 0.0))
+                    else:
+                        self._cond.wait()
+                    take, reason = self._take_locked()
+                del self._queue[: len(take)]
+                n_real = sum(h.count for h in take)
+                self._queued_rows -= n_real
+                if reason == "full":
+                    self._flushes_full += 1
+                elif reason == "deadline":
+                    self._flushes_deadline += 1
+                else:
+                    self._flushes_close += 1
+                self._rows_served += n_real
+                self._rows_padded += self.max_batch - n_real
+            self._run_flush(take, n_real)
+
+    def _run_flush(self, take: List[PredictionHandle], n_real: int) -> None:
+        """Assemble the padded batch, run it, fan outputs back.  Runner
+        failures resolve every request of THIS flush with the error and the
+        flusher survives — one poisoned batch must not wedge the server."""
+        try:
+            batch = {k: t.copy() for k, t in self._template.items()}
+            mask = np.zeros((self.max_batch,), np.float32)
+            mask[:n_real] = 1.0
+            batch[MASK_KEY] = mask
+            lo = 0
+            for h in take:
+                for k in self._template:
+                    arr = np.asarray(h.features[k], self._template[k].dtype)
+                    batch[k][lo : lo + h.count] = arr
+                lo += h.count
+            outputs, meta = self._runner(batch, n_real)
+            lo = 0
+            for h in take:
+                h._resolve(_slice_outputs(outputs, lo, lo + h.count), meta)
+                lo += h.count
+        except BaseException as e:  # noqa: BLE001 — fan the failure back
+            logger.exception("micro-batch flush of %d request(s) failed", len(take))
+            for h in take:
+                h._fail(e)
+
+    # -- lifecycle / observability --
+
+    def stats(self) -> Dict[str, int]:
+        with self._cond:
+            return {
+                "submitted": self._submitted,
+                "queued": len(self._queue),
+                "flushes_full": self._flushes_full,
+                "flushes_deadline": self._flushes_deadline,
+                "flushes_close": self._flushes_close,
+                "rows_served": self._rows_served,
+                "rows_padded": self._rows_padded,
+                "shed_overload": self._shed,
+                "expired": self._expired,
+            }
+
+    def close(self, timeout_s: float = 10.0) -> None:
+        """Stop accepting requests, flush what is queued, join the flusher."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout_s)

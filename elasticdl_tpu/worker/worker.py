@@ -549,6 +549,13 @@ class Worker:
         state = self.state if state is None else state
         self._ckpt.save(step, jax.device_get(state), wait=wait)
         self.trainer.save_host_stores(self._ckpt.directory, step)
+        if wait:
+            # Publish LAST: the manifest is the serving watcher's only
+            # trigger, so it must name a step whose Orbax commit AND
+            # host-store snapshot are both complete (publish drains any
+            # in-flight async save before writing).  The wait=False caller
+            # (none today) would publish at its own completion point.
+            self._ckpt.publish(step)
         with self._ckpt_lock:
             self._last_ckpt_step = step
         self.master.call(
@@ -657,6 +664,9 @@ class Worker:
                         self.trainer.save_host_stores(
                             self._ckpt.directory, step
                         )
+                        # Collective save committed (wait=True above) and
+                        # host shards dumped: rank 0 publishes for serving.
+                        self._ckpt.publish(step)
                         self.master.call(
                             "ReportCheckpoint",
                             {
@@ -1860,6 +1870,9 @@ class Worker:
                     # per step (plain RPC, not collective — no deadlock
                     # risk).
                     self.trainer.save_host_stores(self._ckpt.directory, step)
+                    # Publish for serving: the completed job's final state
+                    # is exactly the checkpoint an online tier wants live.
+                    self._ckpt.publish(step)
                 if self._rank == 0:
                     self.master.call(
                         "ReportCheckpoint",

@@ -90,6 +90,11 @@ def test_checkpoint_resume(tmp_path, devices):
     result = worker.run()
     assert result["step"] == 6
     assert servicer.GetCheckpoint({})["step"] == 6
+    # The save path published the serving manifest (r10): the newest
+    # COMPLETE step, atomically visible to the serving watcher.
+    from elasticdl_tpu.common.checkpoint import read_manifest
+
+    assert read_manifest(ckpt_dir)["step"] == 6
 
     # A fresh worker (new job resuming the same checkpoint dir) starts from
     # the saved step, not from scratch.

@@ -12,7 +12,7 @@ import json
 import os
 import sys
 import time
-from typing import Callable, Optional
+from typing import Callable, Optional, Sequence
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -49,6 +49,27 @@ def code_rev(repo: Optional[str] = None) -> str:
         return rev
     except Exception:
         return ""
+
+
+def latency_stats(samples_ms: Sequence[float], prefix: str = "") -> dict:
+    """p50/p99/mean/max over per-request latencies in MILLISECONDS — the
+    one definition both latency benches (ps_bench, serving_bench) stamp,
+    so percentile conventions cannot drift per tool.  Empty input returns
+    {} (a point with zero completed requests has no latency distribution;
+    callers report their error tallies instead).
+    """
+    if not samples_ms:
+        return {}
+    import numpy as np  # local: keep the module import jax-/numpy-free
+                        # (graftlint's artifact path must cost milliseconds)
+
+    arr = np.asarray(samples_ms, np.float64)
+    return {
+        f"{prefix}p50_ms": round(float(np.percentile(arr, 50)), 2),
+        f"{prefix}p99_ms": round(float(np.percentile(arr, 99)), 2),
+        f"{prefix}mean_ms": round(float(arr.mean()), 2),
+        f"{prefix}max_ms": round(float(arr.max()), 2),
+    }
 
 
 def write_artifact(

@@ -234,6 +234,13 @@ def main() -> None:
     ap.add_argument("--configs", default="mnist,resnet50,resnet50_imagenet,wide_deep,transformer_lm")
     ap.add_argument("--batch", type=int, default=0, help="override global batch")
     ap.add_argument("--measure", type=int, default=MEASURE)
+    ap.add_argument(
+        "--serving", action="store_true",
+        help="also run the serving-tier latency/QPS bench "
+        "(tools/serving_bench.py) after the training configs; it stamps "
+        "its own SERVE artifact — the r10 latency surface alongside "
+        "examples/sec",
+    )
     args = ap.parse_args()
     from elasticdl_tpu.common.platform import probe_devices
 
@@ -272,6 +279,15 @@ def main() -> None:
                 "bench_all_r05.json" if full else "bench_all_partial.json",
                 env_var="BENCH_ALL_OUT" if full else "",
             )
+    if args.serving:
+        from tools.serving_bench import run_bench
+
+        serve = run_bench([50.0, 100.0, 200.0])
+        for p in serve["points"]:
+            print(f"  serving @{p['offered_qps']} QPS: "
+                  f"p50 {p.get('p50_ms', '—')} ms, "
+                  f"p99 {p.get('p99_ms', '—')} ms ({p['errors']} errors)",
+                  file=sys.stderr)
 
 
 if __name__ == "__main__":

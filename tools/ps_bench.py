@@ -11,7 +11,12 @@ Usage: python tools/ps_bench.py [--rows 212992] [--dim 8] [--iters 20]
                                 [--shards 1,2,4]
 Prints one JSON line per fleet size:
   {"shards": n, "pull_rows_per_s": ..., "push_rows_per_s": ...,
-   "pull_ms": ..., "push_ms": ...}
+   "pull_ms": ..., "push_ms": ..., "pull_p50_ms": ..., "pull_p99_ms": ...,
+   "push_p50_ms": ..., "push_p99_ms": ...}
+and stamps the sweep into ``artifacts/ps_bench_r10.json`` (env override
+PS_BENCH_OUT).  Per-REQUEST p50/p99 — not just the aggregate mean — is the
+number the serving tier plans against: its pull path rides this RPC, and a
+latency SLO is a percentile, not an average (r10 satellite).
 
 (212992 rows of dim 8 is exactly the flagship DeepFM step's id volume —
 8192 examples x 26 features.)
@@ -21,13 +26,22 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 from elasticdl_tpu.models.spec import HostTableIO
 from elasticdl_tpu.ps.service import PSServer, RemoteEmbeddingStore
+
+
+def _lat_stats(prefix: str, samples_s: list) -> dict:
+    from tools.artifact import latency_stats
+
+    return latency_stats([s * 1e3 for s in samples_s], prefix=f"{prefix}_")
 
 
 def bench_fleet(n_shards: int, rows: int, dim: int, iters: int) -> dict:
@@ -41,26 +55,30 @@ def bench_fleet(n_shards: int, rows: int, dim: int, iters: int) -> dict:
     rng = np.random.RandomState(0)
     ids = rng.randint(0, 1 << 30, size=(rows,)).astype(np.int64)
     grads = rng.randn(rows, dim).astype(np.float32)
+    pull_lat, push_lat = [], []
     try:
         store.pull(ids)  # materialize rows once (lazy init off the clock)
-        t0 = time.perf_counter()
         for _ in range(iters):
+            t0 = time.perf_counter()
             store.pull(ids)
-        pull_s = (time.perf_counter() - t0) / iters
-        t0 = time.perf_counter()
+            pull_lat.append(time.perf_counter() - t0)
         for _ in range(iters):
+            t0 = time.perf_counter()
             store.push_grad(ids, grads)
-        push_s = (time.perf_counter() - t0) / iters
+            push_lat.append(time.perf_counter() - t0)
     finally:
         store.close()
         for s in servers:
             s.stop()
+    pull_s, push_s = sum(pull_lat) / iters, sum(push_lat) / iters
     return {
         "shards": n_shards,
         "pull_rows_per_s": round(rows / pull_s),
         "push_rows_per_s": round(rows / push_s),
         "pull_ms": round(pull_s * 1e3, 2),
         "push_ms": round(push_s * 1e3, 2),
+        **_lat_stats("pull", pull_lat),
+        **_lat_stats("push", push_lat),
     }
 
 
@@ -91,11 +109,19 @@ def bench_concurrent(
         warm.pull(ids)  # materialize: measured pulls are read-only
     warm.close()
 
+    lat_lock = threading.Lock()
+    latencies = []  # per-REQUEST seconds, pooled across client threads
+
     def worker(ids, store, out, i):
+        local = []
         t0 = time.perf_counter()
         for _ in range(iters):
+            t1 = time.perf_counter()
             store.pull(ids)
+            local.append(time.perf_counter() - t1)
         out[i] = time.perf_counter() - t0
+        with lat_lock:
+            latencies.extend(local)
 
     stores = [RemoteEmbeddingStore("t", dim, addresses) for _ in range(n_threads)]
     times = [0.0] * n_threads
@@ -120,6 +146,7 @@ def bench_concurrent(
         "shards": n_shards,
         "rows_per_s": round(total_rows / wall),
         "wall_s": round(wall, 3),
+        **_lat_stats("pull", latencies),
     }
 
 
@@ -135,19 +162,38 @@ def main() -> None:
              "scaling mode instead of the fleet sweep (e.g. 1,2,4,8)",
     )
     args = ap.parse_args()
+    results = []
     if args.concurrency:
         for n in (int(s) for s in args.concurrency.split(",")):
             result = bench_concurrent(n, args.rows, args.dim, args.iters)
+            results.append(result)
             print(json.dumps(result), flush=True)
-            print(f"  {n} thread(s): {result['rows_per_s']:,} rows/s",
-                  file=sys.stderr)
-        return
-    for n in (int(s) for s in args.shards.split(",")):
-        result = bench_fleet(n, args.rows, args.dim, args.iters)
-        print(json.dumps(result), flush=True)
-        print(f"  {n} shard(s): pull {result['pull_ms']} ms, "
-              f"push {result['push_ms']} ms for {args.rows} rows",
-              file=sys.stderr)
+            print(f"  {n} thread(s): {result['rows_per_s']:,} rows/s, "
+                  f"pull p50 {result['pull_p50_ms']} / p99 "
+                  f"{result['pull_p99_ms']} ms", file=sys.stderr)
+    else:
+        for n in (int(s) for s in args.shards.split(",")):
+            result = bench_fleet(n, args.rows, args.dim, args.iters)
+            results.append(result)
+            print(json.dumps(result), flush=True)
+            print(f"  {n} shard(s): pull p50 {result['pull_p50_ms']} / p99 "
+                  f"{result['pull_p99_ms']} ms, push p50 "
+                  f"{result['push_p50_ms']} / p99 {result['push_p99_ms']} ms "
+                  f"for {args.rows} rows", file=sys.stderr)
+    from tools.artifact import code_rev, write_artifact
+
+    write_artifact(
+        {
+            "metric": "ps_latency",
+            "rows": args.rows,
+            "dim": args.dim,
+            "iters": args.iters,
+            "results": results,
+            "code_rev": code_rev(),
+        },
+        "ps_bench_r10.json",
+        env_var="PS_BENCH_OUT",
+    )
 
 
 if __name__ == "__main__":

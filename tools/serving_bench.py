@@ -1,0 +1,324 @@
+"""Serving-tier latency/QPS bench — the r10 perf surface.
+
+Drives the online prediction service (serving/server.ServingServer) the way
+production traffic would: a tiny host-tier DeepFM whose sparse rows live in
+a real in-process PS shard (ps/service.PSServer), real gRPC on loopback,
+open-loop arrivals at several offered-QPS points, and — mid-run — a hot
+checkpoint reload that must complete with ZERO failed requests.
+
+Latency is measured per request against its SCHEDULED arrival (open-loop):
+a backlogged server shows up as queueing delay in the percentiles instead
+of silently throttling the offered load — the honest way to read "can this
+replica hold N QPS at a p99".
+
+Stamps p50/p99 per offered-QPS point plus the reload's live-path downtime
+into ``artifacts/SERVE_r10.json`` (env override SERVE_OUT) — the second
+first-class perf surface alongside examples/sec (docs/perf.md).
+
+Usage:
+  python tools/serving_bench.py [--qps 50,100,200] [--duration 4]
+      [--max_batch 32] [--max_delay_ms 5] [--clients 8] [--no_reload]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+NUM_DENSE = 13
+NUM_CAT = 26
+
+
+
+
+class _RequestFeed:
+    """Zipf-ish single-example feature generator: most categorical values
+    draw from a small hot pool (the cache's reason to exist), the tail from
+    the full bucket range — pre-generated so the load loop costs nothing."""
+
+    def __init__(self, n: int, buckets: int, hot_pool: int = 200,
+                 hot_frac: float = 0.8, seed: int = 0):
+        rng = np.random.RandomState(seed)
+        hot = rng.randint(0, buckets, size=(hot_pool, NUM_CAT))
+        self.features: List[Dict[str, list]] = []
+        for i in range(n):
+            if rng.rand() < hot_frac:
+                cat = hot[rng.randint(hot_pool)]
+            else:
+                cat = rng.randint(0, buckets, size=(NUM_CAT,))
+            dense = rng.rand(NUM_DENSE) * 100.0
+            self.features.append({
+                "dense": [dense.round(3).tolist()],
+                "cat": [cat.tolist()],
+            })
+
+    def __getitem__(self, i: int) -> Dict[str, list]:
+        return self.features[i % len(self.features)]
+
+
+def _drive_point(
+    address: str,
+    feed: _RequestFeed,
+    offered_qps: float,
+    duration_s: float,
+    n_clients: int,
+    timeout_s: float = 30.0,
+) -> Dict:
+    """Open-loop load: ``offered_qps * duration_s`` requests on a fixed
+    schedule, striped over ``n_clients`` threads (each with its own channel
+    — one client serializing everything would close the loop)."""
+    from elasticdl_tpu.serving.client import ServingClient
+
+    total = max(int(offered_qps * duration_s), 1)
+    interval = 1.0 / offered_qps
+    lat_ms: List[Optional[float]] = [None] * total
+    errors: List[str] = []
+    err_lock = threading.Lock()
+
+    def run_client(cid: int) -> None:
+        client = ServingClient(address)
+        try:
+            client.wait_ready(10.0)
+            for i in range(cid, total, n_clients):
+                target = t0 + i * interval
+                delay = target - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                try:
+                    client.predict(feed[i], timeout_s=timeout_s)
+                    lat_ms[i] = (time.perf_counter() - target) * 1e3
+                except Exception as e:  # noqa: BLE001 — tallied, not fatal
+                    with err_lock:
+                        errors.append(f"req {i}: {type(e).__name__}: {e}")
+        except Exception as e:  # noqa: BLE001 — a client thread dying
+            # pre-loop (wait_ready timeout) must not vanish its whole
+            # request stripe: the accounting below turns every UNISSUED
+            # request into an error, or 'zero failed requests' could
+            # false-pass with 1/n_clients of the load never sent.
+            with err_lock:
+                errors.append(f"client {cid} died: {type(e).__name__}: {e}")
+        finally:
+            client.close()
+
+    threads = [
+        threading.Thread(target=run_client, args=(c,), daemon=True)
+        for c in range(n_clients)
+    ]
+    t0 = time.perf_counter() + 0.05  # shared schedule epoch
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    done = [l for l in lat_ms if l is not None]
+    from tools.artifact import latency_stats
+
+    # Every scheduled request is accounted: completed, individually
+    # errored, or unissued (a dead client thread's stripe) — the error
+    # count is total minus completed, so "0 errors" really means every
+    # request was sent AND answered.  latency_stats of an all-errors
+    # point is {} — the row still stamps its tally and samples.
+    out = {
+        "offered_qps": offered_qps,
+        "achieved_qps": round(len(done) / wall, 1),
+        "n": len(done),
+        "errors": total - len(done),
+        **latency_stats(done),
+    }
+    if errors:
+        out["error_samples"] = errors[:5]
+    return out
+
+
+def run_bench(
+    qps_points: List[float],
+    duration_s: float = 4.0,
+    max_batch: int = 32,
+    max_delay_ms: float = 5.0,
+    n_clients: int = 8,
+    buckets: int = 512,
+    embedding_dim: int = 4,
+    cache_rows: int = 1 << 20,
+    reload_mid_run: bool = True,
+    artifact_path: Optional[str] = None,
+    artifact_name: str = "SERVE_r10.json",
+) -> Dict:
+    """The full bench: PS shard + seeded checkpoint + serving server, one
+    point per offered QPS, hot reload during the MIDDLE point."""
+    import tempfile
+
+    import jax
+
+    from elasticdl_tpu.common.checkpoint import CheckpointManager
+    from elasticdl_tpu.common.config import DistributionStrategy, JobConfig
+    from elasticdl_tpu.models.spec import load_model_spec
+    from elasticdl_tpu.parallel.mesh import create_mesh
+    from elasticdl_tpu.parallel.trainer import Trainer
+    from elasticdl_tpu.ps.service import PSServer
+    from elasticdl_tpu.serving.client import ServingClient
+    from elasticdl_tpu.serving.server import ServingServer
+    from tools.artifact import code_rev
+
+    say = lambda m: print(m, file=sys.stderr, flush=True)
+    spec = load_model_spec(
+        "elasticdl_tpu.models", "deepfm.model_spec",
+        buckets_per_feature=buckets, embedding_dim=embedding_dim,
+        hidden=(32,), host_tier=True,
+    )
+    ps = PSServer(spec.host_io, shard=0, num_shards=1).start()
+    tmp = tempfile.mkdtemp(prefix="serving_bench_")
+    ckpt_dir = os.path.join(tmp, "ckpt")
+
+    # Seed checkpoint: the "training side" publishing step 0.
+    trainer = Trainer(
+        spec,
+        JobConfig(
+            distribution_strategy=DistributionStrategy.ALLREDUCE,
+            ps_addresses=ps.address,
+        ),
+        create_mesh([jax.devices()[0]]),
+    )
+    state0 = trainer.init_state(jax.random.key(0))
+    mgr = CheckpointManager(ckpt_dir)
+    mgr.save(0, jax.device_get(state0), wait=True)
+    mgr.publish(0, code_rev=code_rev())
+
+    server = ServingServer(
+        spec,
+        checkpoint_dir=ckpt_dir,
+        ps_addresses=ps.address,
+        max_batch=max_batch,
+        max_delay_ms=max_delay_ms,
+        cache_rows=cache_rows,
+        poll_interval_s=0.2,
+    ).start()
+    warmup_s = server.warmup()
+    say(f"serving up on {server.address} (compile {warmup_s:.2f}s)")
+
+    feed = _RequestFeed(n=4096, buckets=buckets)
+    points = []
+    reload_info: Dict = {"performed": False}
+    probe = ServingClient(server.address)
+    try:
+        probe.wait_ready(10.0)
+        mid = len(qps_points) // 2
+        for idx, qps in enumerate(qps_points):
+            reloader = None
+            if reload_mid_run and idx == mid:
+                # Publish step 1 halfway through this point's window: the
+                # swap lands under live load, and every request must still
+                # succeed (the acceptance criterion).
+                def do_reload():
+                    time.sleep(duration_s / 2)
+                    params = jax.device_get(state0.params)
+                    params["dense_linear"]["b"] = params["dense_linear"]["b"] + 0.5
+                    state1 = state0.replace(params=params)
+                    mgr.save(1, jax.device_get(state1), wait=True)
+                    t_pub = time.perf_counter()
+                    mgr.publish(1, code_rev=code_rev())
+                    deadline = t_pub + 20.0
+                    while (probe.model_info()["step"] != 1
+                           and time.perf_counter() < deadline):
+                        time.sleep(0.02)
+                    reload_info["publish_to_live_s"] = round(
+                        time.perf_counter() - t_pub, 3
+                    )
+
+                reloader = threading.Thread(target=do_reload, daemon=True)
+                reloader.start()
+            point = _drive_point(
+                server.address, feed, qps, duration_s, n_clients
+            )
+            if reloader is not None:
+                reloader.join(30.0)
+                point["reload_during_point"] = True
+                reload_info["performed"] = True
+                reload_info["during_offered_qps"] = qps
+                reload_info["failed_requests"] = point["errors"]
+            points.append(point)
+            say(f"  {qps:>6} QPS offered: p50 {point.get('p50_ms', '—')} ms, "
+                f"p99 {point.get('p99_ms', '—')} ms, achieved "
+                f"{point['achieved_qps']} ({point['errors']} errors)")
+        info = probe.model_info()
+        if reload_info.get("performed"):
+            reload_info["live_swap_ms"] = info["last_swap_ms"]
+            reload_info["restore_load_s"] = info["last_load_s"]
+            reload_info["reloads"] = info["reloads"]
+        result = {
+            "metric": "serving_latency_vs_qps",
+            "model": "deepfm(host_tier, buckets=%d, dim=%d)" % (buckets, embedding_dim),
+            "transport": "grpc-loopback-json",
+            "max_batch": max_batch,
+            "max_delay_ms": max_delay_ms,
+            "clients": n_clients,
+            "duration_s_per_point": duration_s,
+            "warmup_compile_s": round(warmup_s, 2),
+            "points": points,
+            "reload": reload_info,
+            "batcher": info["batcher"],
+            "embedding_cache": info["cache"],
+            "serving_step": info["step"],
+            "code_rev": code_rev(),
+        }
+    finally:
+        probe.close()
+        server.stop()
+        mgr.close()
+        ps.stop()
+
+    from tools.artifact import write_artifact
+
+    write_artifact(result, artifact_name, env_var="SERVE_OUT",
+                   path=artifact_path, log=say)
+    total_errors = sum(p["errors"] for p in points)
+    if total_errors:
+        say(f"FAIL: {total_errors} failed request(s) across the run")
+    return result
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--qps", default="50,100,200",
+                    help="comma list of offered-QPS points (>= 3 for the "
+                         "artifact contract)")
+    ap.add_argument("--duration", type=float, default=4.0,
+                    help="seconds per QPS point")
+    ap.add_argument("--max_batch", type=int, default=32)
+    ap.add_argument("--max_delay_ms", type=float, default=5.0)
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--buckets", type=int, default=512,
+                    help="hash buckets per categorical feature (id space = "
+                         "26 * buckets)")
+    ap.add_argument("--dim", type=int, default=4)
+    ap.add_argument("--cache_rows", type=int, default=1 << 20)
+    ap.add_argument("--no_reload", action="store_true",
+                    help="skip the mid-run hot reload")
+    ap.add_argument("--artifact", default=None)
+    args = ap.parse_args()
+    result = run_bench(
+        [float(q) for q in args.qps.split(",") if q],
+        duration_s=args.duration,
+        max_batch=args.max_batch,
+        max_delay_ms=args.max_delay_ms,
+        n_clients=args.clients,
+        buckets=args.buckets,
+        embedding_dim=args.dim,
+        cache_rows=args.cache_rows,
+        reload_mid_run=not args.no_reload,
+        artifact_path=args.artifact,
+    )
+    print(json.dumps({"points": result["points"], "reload": result["reload"]}))
+    return 1 if sum(p["errors"] for p in result["points"]) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
