@@ -382,11 +382,18 @@ def main() -> None:
         for k, v in e2e.items()
         if k != "e2e_examples_per_sec_per_chip"
     }
-    # Pipeline shape of record (r9): like the link fields, throughput is
-    # only comparable at equal ingest/prep/lease config — the record guard
-    # in _emit treats a different shape as a different experiment.
+    # Pipeline shape of record (r9, extended r11): like the link fields,
+    # throughput is only comparable at equal ingest/prep/lease config AND
+    # equal step shape (optimizer sharding / donation) — the record guard
+    # in _emit treats a different shape as a different experiment, so a
+    # sharded-optimizer run and a replicated run never compete for the one
+    # record slot.
     extras["pipeline"] = {
-        k: e2e[k] for k in ("ingest_threads", "prep_depth", "lease_batch")
+        k: e2e[k]
+        for k in (
+            "ingest_threads", "prep_depth", "lease_batch",
+            "optimizer_sharding", "donate_train_state",
+        )
         if k in e2e
     }
     _log("done", f"end-to-end {e2e_eps:,.0f} examples/sec/chip "

@@ -8,6 +8,17 @@ pytree — including mesh-sharded embedding tables, which Orbax reads/writes
 per-shard from each device's HBM — and restores it **into any mesh shape**,
 which is exactly the elastic 4->8->4 path: the checkpoint is
 topology-agnostic, the restore target's shardings belong to the new mesh.
+
+Format contract (r11): optimizer state is ALWAYS stored in the CANONICAL
+layout — param-shaped leaves, never the flat dp-sharded layout of
+``--optimizer_sharding`` — because the flat layout's global shapes depend
+on the world size that wrote them.  Writers go through
+``Trainer.host_state`` (or the jitted ``Trainer.snapshot_state`` for
+group-mode collective saves); readers restore through
+``Trainer.restore_template`` / ``adopt_restored``, which re-shard the
+canonical leaves into whatever layout the live mesh runs.  This is what
+lets a checkpoint written by a 4-way sharded job restore into an 8-way or
+replicated one (tests/test_elastic.py).
 """
 
 from __future__ import annotations

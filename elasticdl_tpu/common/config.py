@@ -220,6 +220,33 @@ class JobConfig:
     # whole recovery warm use 2.  Each spare holds one idle interpreter.
     standby_pool: int = 1
 
+    # --- optimizer state layout (parallel/trainer.py) ---
+    # ZeRO-style cross-replica sharding of the optimizer update: every
+    # param-shaped optimizer-state leaf for a REPLICATED (dense) param is
+    # partitioned over the data-parallel mesh axis (flattened and
+    # zero-padded to divisibility), the train step reduce-scatters dense
+    # grads, applies the optax update on each replica's 1/dp shard only,
+    # and all-gathers the fresh params — all inside the one jitted XLA
+    # program.  Cuts per-replica optimizer HBM by ~dp and removes the
+    # redundant full weight update every replica used to compute
+    # ("Automatic Cross-Replica Sharding of Weight Update", PAPERS.md).
+    #   replicated — every replica holds full state (pre-r11 behavior);
+    #   sharded    — always shard (dp > 1 meshes; dp == 1 is a no-op);
+    #   auto       — shard when the replicated dense optimizer state would
+    #                exceed --optimizer_sharding_auto_mb per replica.
+    # Mesh-sharded embedding tables are unaffected either way: their
+    # optimizer slots already co-shard with the table rows.  Checkpoints
+    # are written in the canonical (unsharded) layout in every mode, so
+    # they restore into any world size and either mode.
+    optimizer_sharding: str = "replicated"
+    optimizer_sharding_auto_mb: float = 64.0
+    # Donate the train-state buffers into the jitted train step so XLA
+    # reuses them for the output state (halves peak state memory; the
+    # donated-input discipline TrainLoopError documents).  Off = a
+    # debugging mode: failed steps keep their input state alive at the
+    # cost of a second resident copy.
+    donate_train_state: bool = True
+
     # --- precision ---
     compute_dtype: str = "bfloat16"  # MXU-native; params stay f32
 
@@ -259,6 +286,13 @@ class JobConfig:
             raise ValueError("--async_staleness must be >= 1")
         if self.dcn_data_parallelism < 1:
             raise ValueError("--dcn_data_parallelism must be >= 1")
+        if self.optimizer_sharding not in ("replicated", "sharded", "auto"):
+            raise ValueError(
+                f"--optimizer_sharding must be replicated|sharded|auto, got "
+                f"{self.optimizer_sharding!r}"
+            )
+        if self.optimizer_sharding_auto_mb <= 0:
+            raise ValueError("--optimizer_sharding_auto_mb must be positive")
         # Kept in sync with ops.embedding.LOOKUP_IMPLS (asserted by tests);
         # not imported from there so this module stays jax-free (the master
         # control plane and pod manager must run without jax).

@@ -50,7 +50,7 @@ from elasticdl_tpu.ops.embedding import (
     table_shape,
 )
 
-from elasticdl_tpu.common.jax_compat import axis_size, shard_map
+from elasticdl_tpu.common.jax_compat import axis_size, jit_donating, shard_map
 
 
 class TrainState(struct.PyTreeNode):
@@ -136,18 +136,83 @@ def params_partition_specs(
     return jax.tree_util.tree_map_with_path(spec_for, params)
 
 
+class _OptShard:
+    """Per-param shard-plan entry (a deliberately UNREGISTERED class, so a
+    plan tree treats it as one pytree leaf): how this dense param's
+    optimizer slots lay out over the data-parallel axis.  The canonical
+    param-shaped leaf flattens to [size], zero-pads to [padded] (the
+    smallest multiple of the shard count — the ``pad_embedding_tables``
+    move applied to the flat vector), and shards over the dp axis so each
+    replica holds [padded / dp]."""
+
+    __slots__ = ("shape", "size", "padded")
+
+    def __init__(self, shape: Tuple[int, ...], size: int, padded: int):
+        self.shape = shape
+        self.size = size
+        self.padded = padded
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"_OptShard(shape={self.shape}, size={self.size}, padded={self.padded})"
+
+
+#: Plan marker for leaves the dp-sharding leaves alone (mesh-sharded
+#: embedding tables: their optimizer slots already co-shard with the rows).
+_OPT_KEEP = "keep"
+
+
+def opt_shard_plan(
+    params: Any,
+    tables: List[EmbeddingTableSpec],
+    sharded_embeddings: bool,
+    n_shards: int,
+) -> Any:
+    """Params-structured tree of ``_OptShard`` entries (dense leaves) and
+    ``_OPT_KEEP`` markers (mesh-sharded table leaves)."""
+    table_paths = {t.path for t in tables} if sharded_embeddings else set()
+
+    def entry(path, leaf):
+        if _path_keys(path) in table_paths:
+            return _OPT_KEEP
+        shape = tuple(leaf.shape)
+        size = int(np.prod(shape)) if shape else 1
+        padded = -(-size // n_shards) * n_shards
+        return _OptShard(shape, size, padded)
+
+    return jax.tree_util.tree_map_with_path(entry, params)
+
+
 def opt_state_partition_specs(
-    optimizer: optax.GradientTransformation, params: Any, param_specs: Any
+    optimizer: optax.GradientTransformation,
+    params: Any,
+    param_specs: Any,
+    shard_plan: Any = None,
+    shard_axis: Optional[str] = None,
 ):
     """Partition specs for optax state: param-shaped leaves (momenta etc.)
     inherit their param's spec — co-sharding table optimizer slots with the
-    table rows, as the reference's per-PS-pod Go optimizer state does."""
+    table rows, as the reference's per-PS-pod Go optimizer state does.
+
+    With a ``shard_plan`` (ZeRO-style mode), dense param-shaped leaves are
+    stored flat [padded] and partition over ``shard_axis`` instead; table
+    leaves keep their co-sharded spec, non-param leaves stay replicated."""
     state_shapes = jax.eval_shape(optimizer.init, params)
+    if shard_plan is None:
+        return optax.tree_map_params(
+            optimizer,
+            lambda _, spec: spec,
+            state_shapes,
+            param_specs,
+            transform_non_params=lambda _: P(),
+        )
     return optax.tree_map_params(
         optimizer,
-        lambda _, spec: spec,
+        lambda _, spec, entry: (
+            P(shard_axis) if isinstance(entry, _OptShard) else spec
+        ),
         state_shapes,
         param_specs,
+        shard_plan,
         transform_non_params=lambda _: P(),
     )
 
@@ -220,6 +285,11 @@ class Trainer:
         )
         self.ctx = self._make_ctx()
         self._state_specs = None
+        # ZeRO-style optimizer-state shard plan (opt_shard_plan) — set by
+        # shard_state once the mode resolves against this mesh; None =
+        # replicated layout.
+        self._opt_plan = None
+        self._snapshot_fn = None
         # Per-batch-structure step caches (see _structured); _train_step
         # keeps pointing at the most recently used build (profiling tools).
         self._train_steps: Dict = {}
@@ -346,6 +416,8 @@ class Trainer:
         self._adopt_mesh_axes(mesh)
         self.ctx = self._make_ctx()
         self._state_specs = None
+        self._opt_plan = None
+        self._snapshot_fn = None
         self._train_steps = {}
         self._eval_steps = {}
         self._predict_steps = {}
@@ -367,17 +439,151 @@ class Trainer:
             raise RuntimeError("call init_state/shard_state first")
         return self._state_specs
 
+    # ---- optimizer-state sharding (ZeRO over the data-parallel axis) ----
+
+    def _opt_shard_axis(self) -> str:
+        """The axis optimizer state shards over: the OUTER (data-parallel)
+        mesh axis — ``dp`` on both the flat 1-D mesh and the hierarchical
+        ``(dp, ep)`` mesh."""
+        return self.batch_axes[0]
+
+    def _opt_shard_count(self) -> int:
+        return int(self.mesh.shape[self._opt_shard_axis()])
+
+    def _opt_map(self, fn, opt_state: Any, *rest: Any) -> Any:
+        """Map ``fn(opt_leaf, *rest_leaves)`` over the PARAM-SHAPED leaves
+        of an optax state (momenta etc.), passing non-param leaves (step
+        counts) through untouched.  ``rest`` trees are params-structured."""
+        return optax.tree_map_params(
+            self.spec.optimizer,
+            fn,
+            opt_state,
+            *rest,
+            transform_non_params=lambda x: x,
+        )
+
+    def _resolve_opt_sharding(self, params: Any, plan: Any) -> bool:
+        """Whether THIS mesh runs the sharded optimizer: the config knob,
+        re-resolved per mesh adoption (an elastic resize can change the
+        answer in ``auto`` mode — the canonical host layout bridges)."""
+        mode = getattr(self.config, "optimizer_sharding", "replicated")
+        if mode not in ("sharded", "auto") or self._opt_shard_count() <= 1:
+            return False
+        if mode == "sharded":
+            return True
+        shapes = jax.eval_shape(self.spec.optimizer.init, params)
+        sizes = self._opt_map(
+            lambda leaf, entry: (
+                int(leaf.size) * leaf.dtype.itemsize
+                if isinstance(entry, _OptShard)
+                else 0
+            ),
+            shapes,
+            plan,
+        )
+        per_replica = sum(
+            s for s in jax.tree.leaves(sizes) if isinstance(s, int)
+        )
+        threshold = float(
+            getattr(self.config, "optimizer_sharding_auto_mb", 64.0)
+        ) * (1 << 20)
+        return per_replica >= threshold
+
+    def _opt_canonical(self, opt_state: Any, params: Any) -> Any:
+        """Bring every param-shaped optimizer leaf to the CANONICAL
+        (param-shaped) layout, from EITHER layout.  A flat leaf is always
+        ``[data, zero-pad]`` regardless of which shard count padded it, so
+        ``reshape(-1)[:size]`` recovers the data bit-for-bit — this is
+        what lets a 4->8->4 resize redistribute existing moments instead
+        of re-initializing them, and what makes checkpoints topology- and
+        mode-agnostic."""
+
+        def canon(leaf, p):
+            shape = tuple(np.shape(p))
+            if tuple(np.shape(leaf)) == shape:
+                return leaf
+            size = int(np.prod(shape)) if shape else 1
+            return np.reshape(np.reshape(np.asarray(leaf), -1)[:size], shape)
+
+        return self._opt_map(canon, opt_state, params)
+
+    def _opt_flat_host(self, opt_state: Any, plan: Any) -> Any:
+        """Canonical -> flat-padded host layout per the plan (pure numpy
+        data movement; zero-pad mirrors ``pad_embedding_tables``)."""
+
+        def flat(leaf, entry):
+            if not isinstance(entry, _OptShard):
+                return leaf
+            v = np.reshape(np.asarray(leaf), -1)
+            if entry.padded != entry.size:
+                v = np.concatenate(
+                    [v, np.zeros((entry.padded - entry.size,), v.dtype)]
+                )
+            return v
+
+        return self._opt_map(flat, opt_state, plan)
+
+    def host_state(self, state: TrainState) -> TrainState:
+        """Device -> host state in the CANONICAL layout (param-shaped
+        optimizer leaves) regardless of the live device layout.  This is
+        the ONE representation checkpoints store and elastic reforms
+        bridge through: save it anywhere, restore it into any world size,
+        either optimizer_sharding mode."""
+        state = jax.device_get(state)
+        return state.replace(
+            opt_state=self._opt_canonical(state.opt_state, state.params)
+        )
+
+    def opt_state_bytes_per_device(self, state: TrainState) -> Dict[str, int]:
+        """Per-device resident optimizer-state bytes of a PLACED state —
+        the number the sharded mode exists to cut (replicated leaves count
+        their full copy on every device).  Keys are device ids as strings;
+        bench/tests assert on ``max``."""
+        per: Dict[str, int] = {}
+        for leaf in jax.tree.leaves(state.opt_state):
+            if not hasattr(leaf, "addressable_shards"):
+                continue
+            for shard in leaf.addressable_shards:
+                key = str(shard.device.id)
+                per[key] = per.get(key, 0) + int(shard.data.nbytes)
+        return per
+
     def shard_state(self, state: TrainState) -> TrainState:
-        """Place (or re-place, after a mesh re-formation) state on the mesh."""
+        """Place (or re-place, after a mesh re-formation) state on the mesh.
+
+        Accepts optimizer state in EITHER layout (canonical param-shaped,
+        or the flat dp-sharded layout of any PREVIOUS mesh): leaves are
+        first canonicalized, then laid out for THIS mesh per the resolved
+        optimizer_sharding mode — so an elastic 4->8->4 resize
+        REDISTRIBUTES existing Adam/Adagrad moments instead of rebuilding
+        them."""
         p_specs = params_partition_specs(
             state.params,
             self.spec.embedding_tables,
             self.axis_name,
             self.sharded_embeddings,
         )
-        o_specs = opt_state_partition_specs(
-            self.spec.optimizer, jax.tree.map(jnp.asarray, state.params), p_specs
+        params = jax.tree.map(jnp.asarray, state.params)
+        plan = opt_shard_plan(
+            params,
+            self.spec.embedding_tables,
+            self.sharded_embeddings,
+            self._opt_shard_count(),
         )
+        self._opt_plan = (
+            plan if self._resolve_opt_sharding(params, plan) else None
+        )
+        o_specs = opt_state_partition_specs(
+            self.spec.optimizer,
+            params,
+            p_specs,
+            shard_plan=self._opt_plan,
+            shard_axis=self._opt_shard_axis(),
+        )
+        opt_state = self._opt_canonical(state.opt_state, state.params)
+        if self._opt_plan is not None:
+            opt_state = self._opt_flat_host(opt_state, self._opt_plan)
+        state = state.replace(opt_state=opt_state)
         self._state_specs = TrainState(step=P(), params=p_specs, opt_state=o_specs)
         shardings = jax.tree.map(
             lambda s: NamedSharding(self.mesh, s), self._state_specs
@@ -395,6 +601,87 @@ class Trainer:
             return jax.make_array_from_callback(arr.shape, sh, lambda i: arr[i])
 
         return jax.tree.map(place, state, shardings)
+
+    def restore_template(self, state: TrainState) -> TrainState:
+        """``state_like`` for CheckpointManager.restore.  Checkpoints store
+        the CANONICAL optimizer layout (host_state), so in sharded mode
+        the live flat leaves are swapped for param-shaped REPLICATED
+        targets; replicated mode passes the live state straight through
+        (restore lands directly in the mesh shardings, as before)."""
+        if self._opt_plan is None:
+            return state
+
+        def target(leaf, entry):
+            if not isinstance(entry, _OptShard):
+                return leaf
+            return jax.ShapeDtypeStruct(
+                entry.shape,
+                leaf.dtype,
+                sharding=NamedSharding(self.mesh, P()),
+            )
+
+        return state.replace(
+            opt_state=self._opt_map(target, state.opt_state, self._opt_plan)
+        )
+
+    def adopt_restored(self, state: TrainState) -> TrainState:
+        """Lay a just-restored checkpoint back into the live layout: a
+        no-op in replicated mode; in sharded mode each canonical
+        (replicated) optimizer leaf is flattened, padded and placed over
+        the shard axis — every process placing only its own addressable
+        shards, so this works in multi-process worlds too."""
+        if self._opt_plan is None:
+            return state
+        sh = NamedSharding(self.mesh, P(self._opt_shard_axis()))
+        single = _process_count(self.mesh) <= 1
+        # ONE definition of the canonical->flat padding rule (_opt_flat_host;
+        # np.asarray only touches addressable replicas — the restore target
+        # is replicated); this method adds just the placement.
+        flat = self._opt_flat_host(state.opt_state, self._opt_plan)
+
+        def place(leaf, entry):
+            if not isinstance(entry, _OptShard):
+                return leaf
+            v = np.asarray(leaf)
+            if single:
+                return jax.device_put(v, sh)
+            return jax.make_array_from_callback(v.shape, sh, lambda i, v=v: v[i])
+
+        return state.replace(
+            opt_state=self._opt_map(place, flat, self._opt_plan)
+        )
+
+    # hot-path: dispatch-only by design — ONE jitted device-side copy per
+    # checkpoint boundary, no transfers or collectives on the caller
+    def snapshot_state(self, state: TrainState) -> TrainState:
+        """ONE jitted device-side copy of the live state in the CANONICAL
+        layout: fresh buffers no later step can donate (copying the live
+        state on the host would race donation), optimizer leaves
+        param-shaped so even group-mode collective Orbax saves — which
+        stream device arrays straight to disk — write the topology-
+        agnostic checkpoint format.  Dispatch-only: the caller pays a
+        dispatch RTT, never a drain."""
+        if self._snapshot_fn is None:
+            plan = self._opt_plan
+
+            def snap(s):
+                s = jax.tree.map(jnp.copy, s)
+                if plan is None:
+                    return s
+
+                def canon(leaf, entry):
+                    if not isinstance(entry, _OptShard):
+                        return leaf
+                    return jnp.reshape(
+                        jnp.reshape(leaf, (-1,))[: entry.size], entry.shape
+                    )
+
+                return s.replace(
+                    opt_state=self._opt_map(canon, s.opt_state, plan)
+                )
+
+            self._snapshot_fn = jax.jit(snap)
+        return self._snapshot_fn(state)
 
     def _batch_spec_for(self, leaf) -> P:
         """PartitionSpec for one batch leaf.
@@ -814,10 +1101,21 @@ class Trainer:
             cache[key] = fn
         return fn
 
+    def _train_build_kwargs(self) -> Dict[str, Any]:
+        """The build_train_step kwargs shared by the per-step and scan
+        variants: the optimizer shard plan for this mesh and the donation
+        knob — one definition so the two step shapes cannot drift."""
+        return dict(
+            opt_shard=self._opt_plan,
+            opt_shard_axis=self._opt_shard_axis(),
+            donate=bool(getattr(self.config, "donate_train_state", True)),
+        )
+
     def train_step(self, state: TrainState, batch: Any):
         self._train_step = self._structured(
             self._train_steps, build_train_step, batch,
             host_keys=tuple(sorted(self.spec.host_io)),
+            **self._train_build_kwargs(),
         )
         return self._train_step(state, batch)
 
@@ -869,7 +1167,8 @@ class Trainer:
         ``stacked``: device batch from shard_stacked_batch.  Returns
         (state, metrics dict of [T]-stacked scalars)."""
         self._train_step = self._scanned(
-            self._train_steps, build_train_step, stacked, host_keys=()
+            self._train_steps, build_train_step, stacked, host_keys=(),
+            **self._train_build_kwargs(),
         )
         return self._train_step(state, stacked)
 
@@ -904,6 +1203,9 @@ def build_train_step(
     batch_specs: Any = None,
     batch_axes: Optional[Tuple[str, ...]] = None,
     scan_steps: bool = False,
+    opt_shard: Any = None,
+    opt_shard_axis: Optional[str] = None,
+    donate: bool = True,
 ) -> Callable:
     """The jitted train step.  With ``host_keys`` (host-tier tables), the
     step ALSO differentiates with respect to those injected batch arrays and
@@ -933,6 +1235,66 @@ def build_train_step(
     # Paths of sharded-table grads (params-relative): the collective
     # lookup's transpose sums them within the embedding axis already.
     grad_skip = {t.path for t in spec.embedding_tables} if ctx.sharded_embeddings else set()
+
+    # ZeRO-style sharded weight update (``opt_shard`` is the trainer's
+    # opt_shard_plan tree).  Instead of every replica psum'ing full dense
+    # grads and redundantly computing the full optax update, dense grads
+    # are REDUCE-SCATTERED over the shard axis, the update runs on each
+    # replica's 1/dp flat shard (against its matching param slice and its
+    # resident 1/dp optimizer-state shard), and the fresh updates are
+    # all-gathered back — same math, 1/dp of the optimizer memory and
+    # update FLOPs per replica.  Table leaves (_OPT_KEEP) keep the
+    # existing co-sharded path untouched.
+    if opt_shard is not None:
+        shard_axis = opt_shard_axis or axes[0]
+        n_shards = int(mesh.shape[shard_axis])
+        other_axes = tuple(a for a in axes if a != shard_axis)
+
+        def _pad_flat(x, entry):
+            v = jnp.reshape(x, (-1,))
+            if entry.padded != entry.size:
+                v = jnp.concatenate(
+                    [v, jnp.zeros((entry.padded - entry.size,), v.dtype)]
+                )
+            return v
+
+        def sharded_update(state: TrainState, grads):
+            idx = lax.axis_index(shard_axis)
+
+            def combine_grad(entry, g):
+                if not isinstance(entry, _OptShard):
+                    # Sharded-table grad: already summed within the
+                    # embedding axis by the collective transpose.
+                    return lax.psum(g, dcn_axes) if dcn_axes else g
+                if other_axes:
+                    g = lax.psum(g, other_axes)
+                return lax.psum_scatter(
+                    _pad_flat(g, entry), shard_axis,
+                    scatter_dimension=0, tiled=True,
+                )
+
+            def shard_param(entry, p):
+                if not isinstance(entry, _OptShard):
+                    return p  # table leaf: already the local row shard
+                k = entry.padded // n_shards
+                return lax.dynamic_slice_in_dim(
+                    _pad_flat(p, entry), idx * k, k
+                )
+
+            def expand_update(entry, u):
+                if not isinstance(entry, _OptShard):
+                    return u
+                full = lax.all_gather(u, shard_axis, axis=0, tiled=True)
+                return jnp.reshape(full[: entry.size], entry.shape)
+
+            g_dom = jax.tree.map(combine_grad, opt_shard, grads)
+            p_dom = jax.tree.map(shard_param, opt_shard, state.params)
+            updates, opt_state = spec.optimizer.update(
+                g_dom, state.opt_state, p_dom
+            )
+            updates = jax.tree.map(expand_update, opt_shard, updates)
+            params = optax.apply_updates(state.params, updates)
+            return params, opt_state
 
     # Wrap-padded training tails: the worker marks real rows in
     # ``__mask__`` (exactly as eval does); padded duplicates then carry
@@ -969,10 +1331,15 @@ def build_train_step(
         (loss, out), (grads, host_grads) = jax.value_and_grad(
             loss_fn, argnums=(0, 1), has_aux=True
         )(state.params, host_in)
-        grads = _tree_psum_except(grads, grad_skip, axes, dcn_axes)
         loss = lax.psum(loss, axes)
-        updates, opt_state = spec.optimizer.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
+        if opt_shard is not None:
+            params, opt_state = sharded_update(state, grads)
+        else:
+            grads = _tree_psum_except(grads, grad_skip, axes, dcn_axes)
+            updates, opt_state = spec.optimizer.update(
+                grads, state.opt_state, state.params
+            )
+            params = optax.apply_updates(state.params, updates)
         # Histogram metrics (streaming AUC, common/metrics.HIST_PREFIX) are
         # EVAL machinery — per-minibatch training AUC is noise, and the
         # reference computes AUC only in evaluation — so the train step
@@ -1018,7 +1385,7 @@ def build_train_step(
             out_specs=(state_specs, P()),
             check_vma=False,
         )
-        return jax.jit(mapped, donate_argnums=(0,))
+        return jit_donating(mapped) if donate else jax.jit(mapped)
 
     out_specs: Tuple = (state_specs, P())
     if host_keys:
@@ -1033,7 +1400,7 @@ def build_train_step(
         out_specs=out_specs,
         check_vma=False,
     )
-    return jax.jit(mapped, donate_argnums=(0,))
+    return jit_donating(mapped) if donate else jax.jit(mapped)
 
 
 def build_predict_step(

@@ -219,7 +219,6 @@ class Worker:
         # Background periodic-checkpoint machinery (_save_snapshot_background
         # / _save_group_snapshot_background)
         self._ckpt_thread = None  # guarded-by: _ckpt_lock
-        self._snapshot_fn = None
         # Per-phase wall decomposition of the task loop (common/metrics.py
         # PhaseTimers); snapshots ride every report so the master and the
         # train-job artifact can attribute the job-vs-bench gap to named
@@ -317,7 +316,12 @@ class Worker:
                     # same manager; interleaving two saves tears both.
                     self._join_ckpt()
                     step = int(self.state.step)
-                    self._ckpt.save(step, jax.device_get(self.state), wait=True)
+                    # host_state: the CANONICAL layout — a dp-sharded
+                    # optimizer state must land on disk topology-agnostic,
+                    # the relaunch may join a different world size.
+                    self._ckpt.save(
+                        step, self.trainer.host_state(self.state), wait=True
+                    )
                     # Relaunched processes restore from the LOCAL checkpoint
                     # directory at startup (run()'s newest-restorable walk);
                     # this snapshot makes the resume point the pre-restart
@@ -365,7 +369,12 @@ class Worker:
     def _replace_state(self) -> None:
         """Re-place state on the re-formed mesh: restore the latest checkpoint
         if one exists (the reference's recover-from-snapshot path), else
-        re-shard the live state (pure in-process resize)."""
+        re-shard the live state (pure in-process resize).
+
+        Both paths bridge through the trainer's CANONICAL host layout
+        (``host_state``), so a dp-sharded optimizer state is
+        REDISTRIBUTED across the new world size — a 4->8->4 resize moves
+        the existing Adam moments, it never re-initializes them."""
         assert self.trainer is not None
         restored = None
         # Settle any in-flight BACKGROUND save first: latest_step() must not
@@ -374,8 +383,10 @@ class Worker:
         self._join_ckpt()
         if self._ckpt is not None and self._ckpt.latest_step() is not None:
             self._ckpt.wait()
-            template = self.trainer.shard_state(jax.device_get(self.state))
-            restored = self._ckpt.restore(template)
+            template = self.trainer.shard_state(
+                self.trainer.host_state(self.state)
+            )
+            restored = self._restore_checkpoint(template)
             try:
                 self.trainer.restore_host_stores(
                     self._ckpt.directory, int(restored.step)
@@ -390,8 +401,23 @@ class Worker:
                 )
             logger.info("restored checkpoint step %d", int(restored.step))
         if restored is None:
-            restored = self.trainer.shard_state(jax.device_get(self.state))
+            restored = self.trainer.shard_state(
+                self.trainer.host_state(self.state)
+            )
         self.state = restored
+
+    def _restore_checkpoint(self, state_like, step: Optional[int] = None):
+        """Restore a checkpoint step into the live mesh AND optimizer
+        layout.  Checkpoints always hold the canonical (unsharded)
+        optimizer leaves; restore_template aims the read at param-shaped
+        replicated targets when the live layout is dp-sharded, and
+        adopt_restored lays the result back out flat over the shard axis.
+        Replicated mode degenerates to the old direct restore-into-mesh
+        path."""
+        restored = self._ckpt.restore(
+            self.trainer.restore_template(state_like), step=step
+        )
+        return self.trainer.adopt_restored(restored)
 
     def death_watch_tick(
         self, state: dict, now: float, master_version=None
@@ -547,7 +573,9 @@ class Worker:
         preemption snapshot cannot drift apart.  ``state`` lets the
         preemption path save its single captured reference."""
         state = self.state if state is None else state
-        self._ckpt.save(step, jax.device_get(state), wait=wait)
+        # Canonical layout on disk (trainer.host_state): restores must work
+        # into a DIFFERENT world size / optimizer_sharding mode.
+        self._ckpt.save(step, self.trainer.host_state(state), wait=wait)
         self.trainer.save_host_stores(self._ckpt.directory, step)
         if wait:
             # Publish LAST: the manifest is the serving watcher's only
@@ -577,18 +605,15 @@ class Worker:
     # hot-path: dispatch-only by design — the whole point is that the
     # boundary pays a dispatch RTT, never a drain
     def _snapshot_state(self):
-        """ONE jitted device-side copy of the live state: fresh buffers no
-        later step can donate (copy_to_host_async on the live state would
-        race donation).  Dispatch-only and collective-free, so the caller
-        pays ~a dispatch RTT, not a pipeline drain — in a multi-process
-        mesh every rank copies its own shards with no cross-rank traffic."""
-        if self._snapshot_fn is None:
-            import jax.numpy as jnp
-
-            self._snapshot_fn = jax.jit(
-                lambda s: jax.tree.map(jnp.copy, s)
-            )
-        return self._snapshot_fn(self.state)
+        """ONE jitted device-side copy of the live state in the CANONICAL
+        optimizer layout (trainer.snapshot_state): fresh buffers no later
+        step can donate (copy_to_host_async on the live state would race
+        donation), and group-mode collective Orbax saves — which stream
+        the device arrays straight to disk — therefore write the
+        topology-agnostic checkpoint format even when the live optimizer
+        state is dp-sharded.  Dispatch-only, so the caller pays ~a
+        dispatch RTT, not a pipeline drain."""
+        return self.trainer.snapshot_state(self.state)
 
     def _save_snapshot_background(self, step: int) -> None:
         """Periodic checkpoint OFF the task loop's critical path.
@@ -1079,7 +1104,7 @@ class Worker:
         steps = self._ckpt.all_steps() if self._ckpt is not None else []
         for step in steps:
             try:
-                restored = self._ckpt.restore(self.state, step=step)
+                restored = self._restore_checkpoint(self.state, step=step)
                 self.trainer.restore_host_stores(self._ckpt.directory, step)
                 self.state = restored
                 logger.info("recovered from checkpoint step %d", step)
@@ -1632,7 +1657,7 @@ class Worker:
             restored_step = None
             for step in steps:
                 try:
-                    restored = self._ckpt.restore(self.state, step=step)
+                    restored = self._restore_checkpoint(self.state, step=step)
                     self.trainer.restore_host_stores(
                         self._ckpt.directory, step
                     )
@@ -1860,9 +1885,13 @@ class Worker:
                 # thread here before entering the final collective save.
                 self._join_ckpt()
                 step = int(self.state.step)
+                # Canonical layout either way: group mode canonicalizes on
+                # device (collective saves stream device arrays), the
+                # single-process path on host.
                 payload = (
-                    self.state if self._group_mode
-                    else jax.device_get(self.state)
+                    self.trainer.snapshot_state(self.state)
+                    if self._group_mode
+                    else self.trainer.host_state(self.state)
                 )
                 self._ckpt.save(step, payload, wait=True)
                 if self._rank == 0:

@@ -78,3 +78,28 @@ def test_model_params_override_learning_rate_flag():
         {"w": __import__("jax.numpy", fromlist=["x"]).ones((2,))}, state, params
     )
     assert abs(float(updates["w"][0])) == 0.25
+
+
+def test_optimizer_sharding_knob_validation():
+    import pytest
+
+    JobConfig(optimizer_sharding="sharded").validate()
+    JobConfig(optimizer_sharding="auto").validate()
+    with pytest.raises(ValueError):
+        JobConfig(optimizer_sharding="zero3").validate()
+    with pytest.raises(ValueError):
+        JobConfig(optimizer_sharding_auto_mb=0).validate()
+
+
+def test_optimizer_sharding_flags_parse_and_roundtrip():
+    cfg = parse_args(
+        [
+            "--optimizer_sharding", "auto",
+            "--optimizer_sharding_auto_mb", "16.5",
+            "--donate_train_state", "false",
+        ]
+    )
+    assert cfg.optimizer_sharding == "auto"
+    assert cfg.optimizer_sharding_auto_mb == 16.5
+    assert cfg.donate_train_state is False
+    assert JobConfig.from_env(cfg.to_env()) == cfg

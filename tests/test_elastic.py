@@ -186,3 +186,115 @@ def test_elastic_reform_resumes_from_checkpoint(tmp_path, devices):
     # work since the checkpoint was re-done, never skipped.
     assert result["step"] >= 12
     assert servicer.dispatcher.finished()
+
+
+def test_sharded_moments_survive_2_4_2_reform(devices):
+    """The elastic twist of the r11 sharded optimizer: an in-process
+    2->4->2 resize must REDISTRIBUTE the existing Adam moments across the
+    new shard layout — bit-exactly, since the canonical bridge is pure
+    data movement — never re-initialize them (a silent convergence
+    regression on every join/leave)."""
+    spec = load_model_spec("elasticdl_tpu.models", "deepfm.model_spec", **DEEPFM_TINY)
+    config = JobConfig(
+        distribution_strategy=DistributionStrategy.PARAMETER_SERVER,
+        optimizer_sharding="sharded",
+    )
+    batch = spec.example_batch(32)
+    batch["cat"] = np.arange(32 * 26, dtype=np.int32).reshape(32, 26) % 1000
+
+    t = Trainer(spec, config, create_mesh(devices, num_devices=2))
+    state = t.init_state(jax.random.key(0))
+    for _ in range(2):
+        state, _ = t.train_step(state, t.shard_batch(batch))
+    before = t.host_state(state)  # canonical: param-shaped moments
+
+    # 2 -> 4: the worker reform path (set_mesh + canonical re-placement).
+    t.set_mesh(create_mesh(devices, num_devices=4))
+    state = t.shard_state(before)
+    mid = t.host_state(state)
+    for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(mid)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    state, m4 = t.train_step(state, t.shard_batch(batch))
+    assert np.isfinite(float(m4["loss"]))
+
+    # 4 -> 2, carrying the step trained at 4-way.
+    after4 = t.host_state(state)
+    t.set_mesh(create_mesh(devices, num_devices=2))
+    state = t.shard_state(t.host_state(state))
+    back = t.host_state(state)
+    for a, b in zip(jax.tree.leaves(after4), jax.tree.leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    state, m2 = t.train_step(state, t.shard_batch(batch))
+    assert int(state.step) == 4 and np.isfinite(float(m2["loss"]))
+
+
+def test_sharded_checkpoint_restores_across_world_sizes(tmp_path, devices):
+    """Checkpoints hold the CANONICAL optimizer layout in every mode, so a
+    save from a 4-way sharded trainer restores into a 2-way sharded
+    trainer AND into a replicated one — dense state and moments equal."""
+    from elasticdl_tpu.common.checkpoint import CheckpointManager
+
+    spec = load_model_spec("elasticdl_tpu.models", "deepfm.model_spec", **DEEPFM_TINY)
+
+    def cfg(mode):
+        return JobConfig(
+            distribution_strategy=DistributionStrategy.PARAMETER_SERVER,
+            optimizer_sharding=mode,
+        )
+
+    batch = spec.example_batch(32)
+    batch["cat"] = np.arange(32 * 26, dtype=np.int32).reshape(32, 26) % 1000
+    t4 = Trainer(spec, cfg("sharded"), create_mesh(devices, num_devices=4))
+    state4 = t4.init_state(jax.random.key(0))
+    for _ in range(2):
+        state4, _ = t4.train_step(state4, t4.shard_batch(batch))
+    canonical = t4.host_state(state4)
+
+    ckpt = CheckpointManager(str(tmp_path / "ckpt"))
+    ckpt.save(2, canonical, wait=True)  # the worker's save layout
+
+    for n_dev, mode in ((2, "sharded"), (8, "sharded"), (4, "replicated")):
+        t = Trainer(spec, cfg(mode), create_mesh(devices, num_devices=n_dev))
+        template = t.init_state(jax.random.key(1))  # different init
+        restored = t.adopt_restored(
+            ckpt.restore(t.restore_template(template))
+        )
+        assert int(restored.step) == 2
+        got = t.host_state(restored)
+        for a, b in zip(jax.tree.leaves(canonical), jax.tree.leaves(got)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # And it trains on the target topology.
+        state, metrics = t.train_step(restored, t.shard_batch(batch))
+        assert int(state.step) == 3
+        assert np.isfinite(float(metrics["loss"]))
+    ckpt.close()
+
+
+def test_scale_4_8_4_with_sharded_optimizer(tmp_path, devices):
+    """The full worker elastic scenario (phantom join + leave) with the
+    ZeRO-sharded optimizer on: reforms reshard the optimizer state through
+    the canonical bridge and the job still completes every task exactly
+    once."""
+    config, servicer, reader, spec = _deepfm_job(
+        tmp_path, lease_batch=1, optimizer_sharding="sharded"
+    )
+    worker = Worker(
+        config, DirectMasterProxy(servicer), reader,
+        worker_id="w0", spec=spec, devices=devices, devices_per_worker=4,
+    )
+    orig_get_task = servicer.GetTask
+    counter = {"n": 0}
+
+    def get_task_with_events(req):
+        counter["n"] += 1
+        if counter["n"] == 3:
+            servicer.rendezvous.register("phantom")
+        elif counter["n"] == 5:
+            servicer.rendezvous.remove("phantom")
+        return orig_get_task(req)
+
+    servicer.GetTask = get_task_with_events
+    result = worker.run()
+    assert result["reforms"] == 2
+    assert servicer.dispatcher.finished()
+    assert result["step"] == 12  # no step lost or repeated

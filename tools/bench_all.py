@@ -235,6 +235,13 @@ def main() -> None:
     ap.add_argument("--batch", type=int, default=0, help="override global batch")
     ap.add_argument("--measure", type=int, default=MEASURE)
     ap.add_argument(
+        "--optshard", action="store_true",
+        help="also run the sharded-optimizer bytes/step bench "
+        "(tools/optshard_bench.py) after the training configs; it stamps "
+        "its own OPTSHARD artifact — per-replica optimizer bytes and step "
+        "time, replicated vs sharded, at 1/2/4-way dp",
+    )
+    ap.add_argument(
         "--serving", action="store_true",
         help="also run the serving-tier latency/QPS bench "
         "(tools/serving_bench.py) after the training configs; it stamps "
@@ -279,6 +286,12 @@ def main() -> None:
                 "bench_all_r05.json" if full else "bench_all_partial.json",
                 env_var="BENCH_ALL_OUT" if full else "",
             )
+    if args.optshard:
+        from tools.optshard_bench import main as optshard_main
+
+        # Subprocess-driven (its children pin their own fake device
+        # counts), so running it after the in-process configs is safe.
+        optshard_main([])
     if args.serving:
         from tools.serving_bench import run_bench
 

@@ -176,6 +176,11 @@ def run_e2e(log=lambda msg: None) -> dict:
         "ingest_threads": resolve_threads(config.ingest_threads),
         "prep_depth": config.prep_depth,
         "lease_batch": config.lease_batch,
+        # Step-shape config (r11): the optimizer layout and donation knob
+        # change what the jitted step computes/holds resident, so runs at
+        # different settings are different experiments — same guard.
+        "optimizer_sharding": config.optimizer_sharding,
+        "donate_train_state": config.donate_train_state,
     }
 
 

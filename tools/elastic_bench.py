@@ -95,8 +95,13 @@ def main() -> None:
         """Membership bump -> re-form -> restore -> first step; timed."""
         t0 = time.perf_counter()
         trainer.set_mesh(create_mesh(devices, num_devices=n_devices))
-        template = trainer.shard_state(jax.device_get(state))
-        restored = ckpt.restore(template)
+        # Canonical bridge (trainer.host_state): with --optimizer_sharding
+        # the live opt leaves are dp-flat and must canonicalize before
+        # re-placement; the checkpoint itself is canonical in every mode.
+        template = trainer.shard_state(trainer.host_state(state))
+        restored = trainer.adopt_restored(
+            ckpt.restore(trainer.restore_template(template))
+        )
         t_reform = time.perf_counter() - t0
         t1 = time.perf_counter()
         new_state, m = trainer.train_step(

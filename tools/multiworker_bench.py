@@ -402,6 +402,8 @@ def main() -> None:
             "ingest_threads": resolve_threads(_cfg.ingest_threads),
             "prep_depth": _cfg.prep_depth,
             "lease_batch": _cfg.lease_batch,
+            "optimizer_sharding": _cfg.optimizer_sharding,
+            "donate_train_state": _cfg.donate_train_state,
         }
         from tools.artifact import write_artifact
 

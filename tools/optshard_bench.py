@@ -1,0 +1,296 @@
+"""Sharded-optimizer bench: per-replica optimizer-state bytes and step time,
+replicated vs ZeRO-sharded (--optimizer_sharding), at 1/2/4-way data
+parallelism — the r11 number of record (artifacts/OPTSHARD_r11.json).
+
+Three measurement families, each in its OWN subprocess so the XLA fake
+device count (fixed at backend init) and peak RSS (monotonic per process)
+are honest per point:
+
+- sweep: for dp in {1, 2, 4} x mode in {replicated, sharded}: max
+  per-device resident optimizer bytes (Trainer.opt_state_bytes_per_device)
+  and steady-state step time on a synthetic Criteo-shaped batch.  The
+  sharded claim is bytes <= replicated/dp + padding at equal-or-better
+  step time.
+- donation A/B: the same config with --donate_train_state on/off; the
+  delta in peak RSS is the second resident state copy donation removes
+  (ROADMAP item 1's cheap half — measurable on CPU).
+- parity: one process builds BOTH modes at dp=4, trains N identical
+  steps, and reports the max abs param divergence (float32 reduction-
+  order noise between psum and psum_scatter — docs/architecture.md) plus
+  a bit-exactness check that a 2->4->2 resize preserves the moments.
+
+The model is DeepFM in AllReduce strategy: tables are then REPLICATED
+dense params, so the Adam moments are the classic fully-replicated state
+the sharding exists to cut (in ParameterServer strategy the table slots
+already co-shard with the rows and only the MLP state is at stake).
+
+Usage:
+    python tools/optshard_bench.py [--buckets 4096] [--batch 1024]
+        [--steps 10] [--out artifacts/OPTSHARD_r11.json]
+Env override for the artifact path: OPTSHARD_OUT.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import resource
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+DP_SWEEP = (1, 2, 4)
+WARMUP = 3
+
+
+def _child_env(dp: int) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={dp}"
+    )
+    return env
+
+
+def _load(args):
+    """Child-side model/trainer build (jax already initialized)."""
+    import jax
+
+    from elasticdl_tpu.common.config import JobConfig
+    from elasticdl_tpu.models.spec import load_model_spec
+    from elasticdl_tpu.parallel.mesh import create_mesh
+    from elasticdl_tpu.parallel.trainer import Trainer
+
+    spec = load_model_spec(
+        "elasticdl_tpu.models",
+        "deepfm.model_spec",
+        compute_dtype="float32",
+        buckets_per_feature=args.buckets,
+        embedding_dim=8,
+        hidden=(64, 64),
+    )
+
+    def trainer(mode: str, num_devices: int, donate: bool = True) -> Trainer:
+        cfg = JobConfig(
+            optimizer_sharding=mode, donate_train_state=donate
+        )
+        return Trainer(
+            spec, cfg, create_mesh(jax.devices(), num_devices=num_devices)
+        )
+
+    return spec, trainer
+
+
+def _batch(n: int):
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    return {
+        "dense": rng.uniform(0, 1000, (n, 13)).astype(np.float32),
+        "cat": rng.integers(0, 1 << 30, (n, 26)).astype(np.int64),
+        "labels": (rng.uniform(size=(n,)) < 0.25).astype(np.int32),
+    }
+
+
+def child_measure(args) -> dict:
+    import jax
+
+    spec, make = _load(args)
+    dp = args.dp
+    n = max(args.batch // dp * dp, dp)
+    t = make(args.mode, dp, donate=bool(args.donate))
+    state = t.init_state(jax.random.key(0))
+    opt_bytes = t.opt_state_bytes_per_device(state)
+    batch = t.shard_batch(_batch(n))
+    state, m = t.train_step(state, batch)  # compile
+    jax.block_until_ready(m)
+    for _ in range(WARMUP):
+        state, m = t.train_step(state, batch)
+    jax.block_until_ready(m)
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, m = t.train_step(state, batch)
+    jax.block_until_ready(m)
+    dt = (time.perf_counter() - t0) / args.steps
+    # ru_maxrss is KB on linux; the peak includes compile scratch, so the
+    # donation A/B compares two identically-compiled runs.
+    peak_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    return {
+        "dp": dp,
+        "mode": args.mode,
+        "donate": bool(args.donate),
+        "opt_bytes_per_device_max": max(opt_bytes.values()),
+        "step_ms": round(dt * 1e3, 3),
+        "examples_per_sec": round(n / dt, 1),
+        "global_batch": n,
+        "peak_rss_mb": round(peak_kb / 1024.0, 1),
+        "loss": round(float(m["loss"]), 6),
+    }
+
+
+def child_parity(args) -> dict:
+    import jax
+    import numpy as np
+
+    spec, make = _load(args)
+    dp = args.dp
+    n = max(args.batch // dp * dp, dp)
+    tr = make("replicated", dp)
+    ts = make("sharded", dp)
+    sr = tr.init_state(jax.random.key(0))
+    ss = ts.init_state(jax.random.key(0))
+    host = _batch(n)
+    for _ in range(args.steps):
+        sr, _ = tr.train_step(sr, tr.shard_batch(host))
+        ss, _ = ts.train_step(ss, ts.shard_batch(host))
+    diffs = [
+        float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+        if a.size
+        else 0.0
+        for a, b in zip(
+            jax.tree.leaves(jax.device_get(sr.params)),
+            jax.tree.leaves(jax.device_get(ss.params)),
+        )
+    ]
+    # Elastic 2->4->2 moment preservation, bit-exact: the canonical host
+    # layout bridges every resize, so the redistributed flat shards must
+    # reassemble to the identical moments.
+    h0 = ts.host_state(ss)
+    from elasticdl_tpu.parallel.mesh import create_mesh
+
+    preserved = True
+    for size in (2, dp, 2):
+        ts.set_mesh(create_mesh(jax.devices(), num_devices=size))
+        ss = ts.shard_state(h0)
+        h1 = ts.host_state(ss)
+        preserved = preserved and all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(jax.tree.leaves(h0), jax.tree.leaves(h1))
+        )
+    return {
+        "dp": dp,
+        "steps": args.steps,
+        "max_abs_param_diff": max(diffs),
+        "moments_preserved_2_4_2": preserved,
+    }
+
+
+def _spawn(extra, dp: int, log) -> dict:
+    cmd = [sys.executable, os.path.abspath(__file__), "--child"] + extra
+    log(f"run {' '.join(extra)}")
+    out = subprocess.run(
+        cmd,
+        env=_child_env(dp),
+        capture_output=True,
+        text=True,
+        timeout=600,
+        cwd=_REPO,
+    )
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"child {extra} failed rc={out.returncode}: {out.stderr[-800:]}"
+        )
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def run_bench(args, log=None) -> dict:
+    log = log or (lambda m: print(f"[optshard] {m}", file=sys.stderr, flush=True))
+    base = [
+        "--buckets", str(args.buckets),
+        "--batch", str(args.batch),
+        "--steps", str(args.steps),
+    ]
+    sweep = []
+    for dp in DP_SWEEP:
+        for mode in ("replicated", "sharded"):
+            row = _spawn(
+                base + ["--task", "measure", "--mode", mode, "--dp", str(dp)],
+                dp, log,
+            )
+            sweep.append(row)
+            log(
+                f"dp={dp} {mode}: {row['opt_bytes_per_device_max']:,} "
+                f"opt B/device, {row['step_ms']} ms/step"
+            )
+    by = {(r["dp"], r["mode"]): r for r in sweep}
+    checks = {}
+    for dp in DP_SWEEP:
+        if dp == 1:
+            continue
+        rep, sh = by[(dp, "replicated")], by[(dp, "sharded")]
+        # "<= replicated/dp + padding": padding is bounded by one flat
+        # shard row per param-shaped leaf; 5% covers it at bench sizes.
+        checks[f"bytes_ok_dp{dp}"] = (
+            sh["opt_bytes_per_device_max"]
+            <= rep["opt_bytes_per_device_max"] / dp * 1.05
+        )
+        checks[f"step_ratio_dp{dp}"] = round(
+            sh["step_ms"] / rep["step_ms"], 3
+        )
+    donation = {}
+    for donate in (1, 0):
+        row = _spawn(
+            base + [
+                "--task", "measure", "--mode", "replicated",
+                "--dp", "1", "--donate", str(donate),
+            ],
+            1, log,
+        )
+        donation["on" if donate else "off"] = row
+    donation["delta_mb"] = round(
+        donation["off"]["peak_rss_mb"] - donation["on"]["peak_rss_mb"], 1
+    )
+    log(f"donation peak-RSS delta: {donation['delta_mb']} MB")
+    parity = _spawn(
+        base + ["--task", "parity", "--mode", "sharded", "--dp", "4"], 4, log
+    )
+    log(f"parity: {parity}")
+    return {
+        "metric": "optimizer_sharding_bytes_and_step",
+        "model": f"deepfm AllReduce buckets={args.buckets} dim=8 hidden=(64,64)",
+        "sweep": sweep,
+        "checks": checks,
+        "donation": donation,
+        "parity": parity,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python tools/optshard_bench.py")
+    ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--task", default="measure", choices=("measure", "parity"))
+    ap.add_argument(
+        "--mode", default="replicated", choices=("replicated", "sharded")
+    )
+    ap.add_argument("--dp", type=int, default=1)
+    ap.add_argument("--donate", type=int, default=1)
+    ap.add_argument("--buckets", type=int, default=4096)
+    ap.add_argument("--batch", type=int, default=1024)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--out", default="")
+    args = ap.parse_args(argv)
+    if args.child:
+        result = (
+            child_parity(args) if args.task == "parity" else child_measure(args)
+        )
+        print(json.dumps(result), flush=True)
+        return 0
+    result = run_bench(args)
+    from tools.artifact import code_rev, write_artifact
+
+    result["code_rev"] = code_rev()
+    write_artifact(
+        result, "OPTSHARD_r11.json", env_var="OPTSHARD_OUT",
+        path=args.out or None,
+    )
+    print(json.dumps(result["checks"]))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
