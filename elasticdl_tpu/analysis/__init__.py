@@ -18,6 +18,9 @@ gated, not reviewed, into compliance:
 - ``thread-hygiene``    every ``threading.Thread`` is daemonized or joined
 - ``import-hygiene``    master/bench-process modules stay jax-free at
                         import time (transitive)
+- ``trace-discipline``  ``# hot-path`` functions emit trace events only via
+                        the non-blocking ring API (``common/trace.py``
+                        span/instant); export/drain calls are findings
 
 v2 adds the interprocedural layer (``analysis/callgraph.py``: resolved
 self-method and module-function call edges across the repo):
@@ -65,6 +68,7 @@ from elasticdl_tpu.analysis.lock_discipline import LockDisciplinePass
 from elasticdl_tpu.analysis.lock_order import LockOrderPass
 from elasticdl_tpu.analysis.rpc_discipline import RpcDisciplinePass
 from elasticdl_tpu.analysis.thread_hygiene import ThreadHygienePass
+from elasticdl_tpu.analysis.trace_discipline import TraceDisciplinePass
 
 
 def all_passes() -> list:
@@ -79,4 +83,5 @@ def all_passes() -> list:
         ThreadHygienePass(),
         ImportHygienePass(),
         LockOrderPass(),
+        TraceDisciplinePass(),
     ]

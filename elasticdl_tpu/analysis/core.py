@@ -35,6 +35,9 @@ KNOWN_RULES = {
     "rpc-discipline",
     "thread-hygiene",
     "import-hygiene",
+    # r12: hot-path trace emission must use the non-blocking ring API only
+    # (common/trace.py's span/instant); export/drain calls are findings.
+    "trace-discipline",
     # v2 interprocedural passes (analysis/callgraph.py layer).
     "blocking-propagation",
     "lock-order",

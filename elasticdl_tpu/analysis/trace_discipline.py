@@ -1,0 +1,103 @@
+"""trace-discipline: hot-path trace emission uses the ring API only.
+
+``common/trace.py`` splits its surface deliberately: ``span``/``instant``/
+``add_complete`` are non-blocking ring appends (GIL-atomic deque — legal
+anywhere, including ``# hot-path`` functions), while ``drain_slice``/
+``export``/``chrome_events`` walk or drain the buffer and belong on
+control-plane boundaries (heartbeats, checkpoint reports, dump tools).  An
+export call inside a hot-path function would make TRACING the thing that
+stalls the traced hot path — the exact failure mode the recorder's design
+exists to rule out.  This pass keeps the split enforced: any call whose
+attribute name is one of the export methods, inside a ``# hot-path``
+function's steady-state body, is a finding.
+
+Scope notes, mirroring ``hot-path-sync``'s conventions:
+
+- ``except`` handler bodies and nested ``def``/``lambda`` bodies are
+  exempt (error paths and deferred execution own their own time);
+- unlike blocking calls, a ``phases.phase(...)`` boundary does NOT excuse
+  an export — a drain is control-plane work, not an accountable phase of
+  the hot path; waive with a reason if a hot-path drain is ever truly
+  intended.
+
+The export-method names are distinctive enough (``drain_slice``,
+``chrome_events``) that receiver resolution is unnecessary — matching the
+attribute name alone keeps the pass as simple as the rest of the v1 suite
+(``export`` is checked with a trace-shaped receiver to avoid punishing
+unrelated exporters).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from elasticdl_tpu.analysis.core import Finding, LintPass, SourceFile, attr_chain
+
+#: Export-API attribute names that always flag in a hot-path body.
+_EXPORT_ATTRS = {"drain_slice", "chrome_events"}
+
+#: ``export`` is a common verb; only flag it when the receiver chain looks
+#: like a trace recorder (``trace.default().export()`` bottoms out in a
+#: call, so the chain is empty — match on the attribute one level up too).
+_TRACE_RECEIVER_HINTS = ("trace", "rec", "recorder", "_REC")
+
+
+def _is_export_call(node: ast.Call) -> bool:
+    f = node.func
+    if not isinstance(f, ast.Attribute):
+        return False
+    if f.attr in _EXPORT_ATTRS:
+        return True
+    if f.attr == "export":
+        chain = attr_chain(f)
+        if chain:
+            recv = chain.rsplit(".", 1)[0].split(".")[-1]
+            return recv in _TRACE_RECEIVER_HINTS
+        # Dynamic receiver (e.g. ``trace.default().export()``): the inner
+        # call's own name is the hint.
+        inner = f.value
+        if isinstance(inner, ast.Call):
+            ichain = attr_chain(inner.func)
+            return any(
+                part in _TRACE_RECEIVER_HINTS for part in ichain.split(".")
+            )
+    return False
+
+
+class TraceDisciplinePass(LintPass):
+    name = "trace-discipline"
+    description = (
+        "functions marked '# hot-path' may emit trace events only through "
+        "the non-blocking ring API (span/instant/add_complete); export "
+        "calls (drain_slice/export/chrome_events) are findings"
+    )
+
+    def run(self, src: SourceFile) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if src.is_hot_path(node.lineno):
+                    self._walk(src, node.body, findings)
+        return findings
+
+    def _walk(self, src, body, findings) -> None:
+        for node in body:
+            self._visit(src, node, findings)
+
+    def _visit(self, src, node, findings) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return  # deferred execution: not this function's hot path
+        if isinstance(node, ast.Try):
+            for stmt in node.body + node.orelse + node.finalbody:
+                self._visit(src, stmt, findings)
+            return  # handlers (error path) skipped
+        if isinstance(node, ast.Call) and _is_export_call(node):
+            findings.append(Finding(
+                self.name, src.path, node.lineno,
+                "trace export/drain inside a '# hot-path' function — ship "
+                "slices from a control-plane boundary (heartbeat/report) "
+                "instead, or waive with a reason",
+            ))
+        for child in ast.iter_child_nodes(node):
+            self._visit(src, child, findings)

@@ -31,6 +31,7 @@ from typing import Any, Dict, Optional
 import jax
 import orbax.checkpoint as ocp
 
+from elasticdl_tpu.common import trace
 from elasticdl_tpu.common.log_utils import get_logger
 
 logger = get_logger("checkpoint")
@@ -72,6 +73,10 @@ def publish_manifest(
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+    # The publish is the training->serving hand-off edge: its instant in
+    # the merged trace is what publish-to-live latency is measured between
+    # (pairs with the watcher's serving:hot_reload instant).
+    trace.instant("ckpt:publish", cat="elastic", step=int(step))
     return path
 
 

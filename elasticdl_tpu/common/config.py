@@ -207,6 +207,18 @@ class JobConfig:
 
     # --- observability ---
     log_level: str = "INFO"
+    # grafttrace (common/trace.py): per-process span recorder for the
+    # cross-process structured trace.  Workers emit spans for every
+    # PhaseTimers phase, RPC boundary, gang wait and elastic transition,
+    # ship bounded slices to the master on the heartbeat/report channel,
+    # and tools/trace_dump.py merges a live job's buffers into one
+    # Perfetto-loadable file (docs/observability.md).  Off by default;
+    # measured overhead on the ingest bench is <2% (artifacts/
+    # TRACE_r12.json), so flipping it on a production job is safe.
+    trace: bool = False
+    # Ring capacity (events) of the per-process trace buffer; oldest events
+    # are overwritten, so the buffer always holds the most recent window.
+    trace_buffer_events: int = 65536
     profile_dir: str = ""  # worker: jax.profiler trace of one training task
     metrics_dir: str = ""  # master: JSONL + TensorBoard scalar stream
     # Process backend: capture each worker pod's stdout+stderr to
@@ -293,6 +305,8 @@ class JobConfig:
             )
         if self.optimizer_sharding_auto_mb <= 0:
             raise ValueError("--optimizer_sharding_auto_mb must be positive")
+        if self.trace_buffer_events < 1:
+            raise ValueError("--trace_buffer_events must be >= 1")
         # Kept in sync with ops.embedding.LOOKUP_IMPLS (asserted by tests);
         # not imported from there so this module stays jax-free (the master
         # control plane and pod manager must run without jax).

@@ -17,7 +17,7 @@ import threading
 import time
 from typing import Dict, Optional
 
-from elasticdl_tpu.common import locksan
+from elasticdl_tpu.common import locksan, trace
 from elasticdl_tpu.common.log_utils import get_logger
 
 logger = get_logger("metrics")
@@ -135,11 +135,19 @@ class PhaseTimers:
             stack = self._local.stack = []
         child_wall = [0.0]
         stack.append(child_wall)
+        # Every phase doubles as a trace span (category "phase") when the
+        # process recorder is on: the cross-process trace view decomposes
+        # by the SAME names as the cumulative timers, and the span's
+        # independent self-time arithmetic is pinned against ours by tests.
+        # Disabled, span() is a shared no-op — one attribute check.
+        sp = trace.span(name, cat="phase")
+        sp.__enter__()
         t0 = time.perf_counter()
         try:
             yield
         finally:
             elapsed = time.perf_counter() - t0
+            sp.__exit__(None, None, None)
             stack.pop()
             if stack:
                 # Report the full wall to the enclosing phase so IT can
@@ -180,13 +188,23 @@ def critical_path_seconds(phase_times: Dict[str, float]) -> float:
 
 
 class MetricsWriter:
-    """Append-only JSONL scalar stream + optional TensorBoard mirror."""
+    """Append-only JSONL scalar stream + optional TensorBoard mirror.
+
+    One append handle for the stream's whole life (closed in ``close()``):
+    the old open-per-record idiom paid an open/close syscall pair per
+    report AND left a window where a crash mid-write tore the final line
+    with no reader-side tolerance.  Crash-safe append now means what it
+    says: each record is one ``write`` of a full line followed by a flush
+    (the OS appends atomically for these sizes), and ``read_metrics``
+    drops a torn FINAL line instead of raising.
+    """
 
     def __init__(self, directory: str, tensorboard: bool = True):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self._path = os.path.join(self.directory, "metrics.jsonl")
         self._lock = locksan.lock("MetricsWriter._lock", leaf=True)  # lock-order: leaf
+        self._f = open(self._path, "a")  # guarded-by: _lock
         self._tb = None
         if tensorboard:
             try:
@@ -208,23 +226,48 @@ class MetricsWriter:
         }
         line = json.dumps(record, sort_keys=True)
         with self._lock:
-            with open(self._path, "a") as f:
-                f.write(line + "\n")
+            if self._f is None:
+                # A report racing close() (gRPC pool thread vs master
+                # teardown) must not crash the handler: reopen for the
+                # straggler record — append keeps the stream consistent.
+                self._f = open(self._path, "a")
+            self._f.write(line + "\n")
+            self._f.flush()
             if self._tb is not None:
                 for key, value in metrics.items():
                     self._tb.add_scalar(f"{kind}/{key}", float(value), int(step))
 
     def close(self) -> None:
         with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
             if self._tb is not None:
                 self._tb.close()
                 self._tb = None
 
 
 def read_metrics(directory: str) -> list:
-    """All records of a job's metrics.jsonl (tests, CLI inspection)."""
+    """All records of a job's metrics.jsonl (tests, CLI inspection).
+
+    Tolerates a torn FINAL line — the one legal artifact of a crash mid-
+    append — by dropping it; garbage anywhere earlier still raises (that is
+    corruption, not a crash tail, and silently skipping it would hide it).
+    """
     path = os.path.join(os.path.abspath(directory), "metrics.jsonl")
     if not os.path.exists(path):
         return []
     with open(path) as f:
-        return [json.loads(line) for line in f if line.strip()]
+        lines = f.read().splitlines()
+    records = []
+    last = len(lines) - 1
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            records.append(json.loads(line))
+        except json.JSONDecodeError:
+            if i == last:
+                break  # torn final append: the crash tail, not corruption
+            raise
+    return records

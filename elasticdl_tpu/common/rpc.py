@@ -16,7 +16,24 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import grpc
 
+from elasticdl_tpu.common import trace
+
 SERVICE_NAME = "elasticdl.Master"
+
+#: gRPC message cap for the master service, BOTH sides (same stance as the
+#: PS tier's GRPC_MAX_MESSAGE_BYTES): the control-plane default of 4 MB
+#: was fine for task/report traffic, but a DumpTrace response carries up
+#: to a full 65536-event ring per process (~10-16 MB of JSON) — the
+#: live-job introspection tool must not break exactly when the trace is
+#: large.  64 MB covers several full rings with headroom.
+GRPC_MAX_MESSAGE_BYTES = 64 << 20
+
+#: Channel/server options applying the cap (send AND receive: the server
+#: sends the big dump, the tool receives it).
+GRPC_MESSAGE_OPTIONS = [
+    ("grpc.max_send_message_length", GRPC_MAX_MESSAGE_BYTES),
+    ("grpc.max_receive_message_length", GRPC_MAX_MESSAGE_BYTES),
+]
 
 #: Wire-contract version, negotiated at RegisterWorker (the one RPC every
 #: worker must issue first).  Bump when a message's shape changes
@@ -113,7 +130,33 @@ MASTER_SCHEMAS: Dict[str, MessageSchema] = {
         optional={"phase_times": _DICT, "worker_id": _STR},
     ),
     "JobStatus": MessageSchema(),
+    # DumpTrace (r12): the live-job introspection pull — returns every
+    # process's shipped trace buffer plus the master's own recorder window
+    # (tools/trace_dump.py merges them into one Chrome-trace JSON with
+    # clock alignment).  Non-draining: repeated dumps see the same window.
+    # A new METHOD is additive by construction (an old master returns
+    # UNIMPLEMENTED, an old worker never calls it) — no PROTOCOL_VERSION
+    # bump, the same stance as r9's lease field.
+    "DumpTrace": MessageSchema(),
 }
+
+# trace (r12): the cross-process trace envelope, additive and optional on
+# EVERY master method (same no-version-bump stance as r9's lease):
+#   {"ctx": [span_id]}            — the caller's live span, injected by
+#                                   JsonRpcClient so the servicer's span
+#                                   can name its remote parent;
+#   {"events": [...],             — a bounded slice of the worker's ring
+#    "clock_offset_us": float,      buffer riding the Heartbeat/Report
+#    "dropped": int}                channel (the pull path's supply side),
+#                                   with the worker's RTT-midpoint clock
+#                                   offset vs the master.
+# phase_counts rides beside phase_times on the report/heartbeat methods:
+# PhaseTimers.counts() — per-phase entry counts, so consumers can compute
+# per-phase AVERAGES, not just cumulative sums, from artifacts.
+for _method_schema in MASTER_SCHEMAS.values():
+    _method_schema.optional.setdefault("trace", _DICT)
+for _method in ("ReportTaskResult", "Heartbeat", "ReportCheckpoint"):
+    MASTER_SCHEMAS[_method].optional.setdefault("phase_counts", _DICT)
 
 
 SERVING_SERVICE_NAME = "elasticdl.Serving"
@@ -196,8 +239,29 @@ def make_generic_handler(
                     validate_message(name, req, schemas)
                 except SchemaError as e:
                     ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            # Server half of the RPC span: names its remote parent (the
+            # client span id propagated in the trace envelope) so the
+            # merged view links one logical RPC across the two processes.
+            remote = 0
+            if isinstance(req, dict):
+                tctx = req.get("trace")
+                if isinstance(tctx, dict):
+                    # Shape-checked, never trusted: the schema only says
+                    # "trace is a dict", and a malformed envelope must
+                    # degrade to "no parent" — not turn every method into
+                    # an unstructured INTERNAL before its handler runs.
+                    tc = tctx.get("ctx")
+                    if (
+                        isinstance(tc, (list, tuple)) and tc
+                        and isinstance(tc[0], int)
+                    ):
+                        remote = tc[0]
             try:
-                return fn(req)
+                with trace.span(
+                    f"rpc:{name}", cat="rpc.server",
+                    method=name, remote_parent=remote,
+                ):
+                    return fn(req)
             except SchemaError as e:
                 # Contract violations detected INSIDE a handler (e.g. the
                 # RegisterWorker protocol-version check) surface as the same
@@ -231,7 +295,9 @@ class JsonRpcClient:
         service_name: str = SERVICE_NAME,
         schemas: Optional[Dict[str, MessageSchema]] = None,
     ):
-        self._channel = grpc.insecure_channel(address)
+        self._channel = grpc.insecure_channel(
+            address, options=GRPC_MESSAGE_OPTIONS
+        )
         self._service = service_name
         self._stubs: Dict[str, Callable] = {}
         if schemas is None and service_name == SERVICE_NAME:
@@ -250,7 +316,22 @@ class JsonRpcClient:
                 request_serializer=_serialize,
                 response_deserializer=_deserialize,
             )
-        return self._stubs[method](request, timeout=timeout_s)
+        # Client half of the RPC span (deadline attribute included — a
+        # deadline-bounded wait that times out shows as a span of exactly
+        # that length).  The span id propagates in the request's trace
+        # envelope; the request dict is COPIED before injection so a caller
+        # reusing its dict (retries, pipelined reports) is never mutated.
+        sp = trace.span(
+            f"rpc:{method}", cat="rpc.client",
+            method=method, deadline_s=timeout_s,
+        )
+        with sp:
+            if sp.span_id and isinstance(request, dict):
+                envelope = dict(request.get("trace") or {})
+                envelope["ctx"] = [sp.span_id]
+                request = dict(request)
+                request["trace"] = envelope
+            return self._stubs[method](request, timeout=timeout_s)
 
     def close(self) -> None:
         self._channel.close()

@@ -81,6 +81,16 @@ class Master:
     ):
         config.validate()
         self.config = config
+        if config.trace:
+            # Master-side spans (rpc.server handlers, dispatcher lease
+            # events) join the same merged trace the workers ship into —
+            # and the master clock is the reference every worker offset
+            # aims at (stdlib recorder: the control plane stays jax-free).
+            from elasticdl_tpu.common import trace as _trace
+
+            _trace.configure(
+                enabled=True, capacity=config.trace_buffer_events
+            )
         records_per_task = (
             config.minibatch_size * config.num_minibatches_per_task
         )

@@ -23,19 +23,29 @@ Method table (the wire contract):
   GetCheckpoint      {}                                -> {path?, step}
   ReportCheckpoint   {path, step}                      -> {}
   JobStatus          {}                                -> counts + metrics
+  DumpTrace          {}                                -> per-process trace
+                                                         buffers + master's
+
+Every method additionally accepts the optional ``trace`` envelope
+(common/rpc.py): span context from the caller, and — on Heartbeat/Report
+methods — bounded slices of the worker's trace ring buffer, which the
+master accumulates per worker for DumpTrace (the live-job introspection
+pull that tools/trace_dump.py merges into one Chrome trace).
 """
 
 from __future__ import annotations
 
 import threading
+from collections import deque
 from concurrent import futures
 from typing import Dict, Optional
 
 import grpc
 
-from elasticdl_tpu.common import locksan
+from elasticdl_tpu.common import locksan, trace
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.rpc import (
+    GRPC_MESSAGE_OPTIONS,
     MASTER_SCHEMAS,
     PROTOCOL_VERSION,
     SERVICE_NAME,
@@ -91,6 +101,15 @@ class MasterServicer:
         # so the train-job tool can attribute job-vs-bench throughput gaps
         # to named phases (VERDICT r5 Weak #1: the 5.4x gap was guessed).
         self._phase_times: Dict[str, dict] = {}  # guarded-by: _lock
+        # Per-phase entry COUNTS (PhaseTimers.counts), beside the seconds:
+        # sums alone cannot answer "how long is one lease RPC on average" —
+        # counts make per-phase means computable from the same artifact.
+        self._phase_counts: Dict[str, dict] = {}  # guarded-by: _lock
+        # Per-worker trace buffers (bounded ring each, like the worker's
+        # own): Heartbeat/Report-borne slices land here; DumpTrace reads
+        # them.  clock_offset_us is the worker's RTT-midpoint estimate of
+        # (master clock - worker clock), shipped alongside its events.
+        self._trace_buffers: Dict[str, dict] = {}  # guarded-by: _lock
         # master wires _persist_progress here
         self._on_checkpoint = None  # guarded-by: _lock
         # final_eval: run one last eval round after the training tasks drain,
@@ -131,6 +150,7 @@ class MasterServicer:
         with self._lock:
             gone = self._known_workers - set(members)
             self._known_workers = set(members)
+            self._bound_departed_trace_buffers(set(members))
         for worker_id in gone:
             lost = self.dispatcher.recover_tasks(worker_id)
             lost_eval = (
@@ -321,6 +341,7 @@ class MasterServicer:
         success = bool(req.get("success", True))
         task_type = req.get("task_type", "")
         self._record_phase_times(req)
+        self._record_trace(req)
         if task_type == TASK_EVALUATION and self.evaluation is not None:
             # Metrics BEFORE report_task: completing the round's last task
             # snapshots the aggregate.
@@ -370,8 +391,11 @@ class MasterServicer:
             # beside the same worker's real entry and double-count in any
             # consumer summing across workers (the timers are cumulative).
             return
+        counts = req.get("phase_counts")
         with self._lock:
             self._phase_times[worker_id] = dict(phases)
+            if counts:
+                self._phase_counts[worker_id] = dict(counts)
             fallback_version = self._model_version
         if (
             stream
@@ -387,6 +411,93 @@ class MasterServicer:
                 )
             except Exception:  # malformed values must not fail the report
                 logger.exception("phase_times metrics write failed")
+
+    #: Bound on each worker's master-side trace ring (events).  A straggler
+    #: hunt wants the RECENT window, so overwrite-oldest per worker — the
+    #: same policy as the worker's own ring.
+    TRACE_BUFFER_EVENTS = 65536
+
+    #: How many DEPARTED workers' trace rings the master retains (most
+    #: recently updated win).  Keeping some is deliberate — a finished
+    #: worker's job-end tail is dumped AFTER it exits, and a crashed
+    #: straggler's final window is exactly what an investigation wants —
+    #: but each ring is up to ~10 MB, so without a cap a long elastic job
+    #: would grow memory with HISTORICAL membership, not current world
+    #: size.  (The per-worker phase_times/phase_counts dicts stay for all
+    #: departed workers on purpose: they are a few floats each, and the
+    #: gang artifacts read them after the fleet exits.)
+    TRACE_DEPARTED_KEEP = 8
+
+    def _bound_departed_trace_buffers(self, members: set) -> None:  # guarded-by: _lock
+        # Plain loop, no sort-key closure: a lambda would not inherit the
+        # caller-holds-lock annotation (lock-discipline's closure rule).
+        by_age = []
+        for w, buf in self._trace_buffers.items():
+            if w not in members:
+                by_age.append((buf["updated"], w))
+        by_age.sort()  # oldest-updated first
+        for _, w in by_age[: max(0, len(by_age) - self.TRACE_DEPARTED_KEEP)]:
+            del self._trace_buffers[w]
+
+    # hot-path: rides every report and heartbeat — a bounded deque extend
+    # under the state lock, never an RPC or an export
+    def _record_trace(self, req: dict) -> None:
+        """Bank a Heartbeat/Report-borne trace slice into the sender's
+        master-side ring.  Slices are DRAINED from the worker's buffer, so
+        this is the sole surviving copy — DumpTrace republishes it."""
+        payload = req.get("trace")
+        if not isinstance(payload, dict):
+            return
+        events = payload.get("events")
+        if not events:
+            return
+        worker_id = req.get("worker_id", "")
+        if not worker_id:
+            return  # unattributable events cannot merge into a per-process view
+        with self._lock:
+            buf = self._trace_buffers.get(worker_id)
+            if buf is None:
+                buf = self._trace_buffers[worker_id] = {
+                    "events": deque(maxlen=self.TRACE_BUFFER_EVENTS),
+                    "clock_offset_us": None,
+                    "dropped": 0,
+                    "updated": 0.0,
+                }
+            buf["updated"] = trace.now_us()
+            buf["events"].extend(e for e in events if isinstance(e, dict))
+            # Type-checked, never coerced: telemetry riding a heartbeat
+            # must not be able to crash the heartbeat — a peer shipping a
+            # malformed offset would otherwise never beat again and time
+            # out of the membership.
+            offset = payload.get("clock_offset_us")
+            if isinstance(offset, (int, float)) and not isinstance(offset, bool):
+                buf["clock_offset_us"] = float(offset)
+            dropped = payload.get("dropped")
+            if isinstance(dropped, int) and not isinstance(dropped, bool):
+                buf["dropped"] = dropped
+
+    def DumpTrace(self, req: dict) -> dict:
+        """The live-job introspection pull: every process's shipped trace
+        window plus the master's own recorder.  Non-draining — operators
+        dump a RUNNING job without perturbing what the next dump sees
+        (beyond the rings' natural overwrite)."""
+        with self._lock:
+            processes = {
+                w: {
+                    "events": list(b["events"]),
+                    "clock_offset_us": b["clock_offset_us"],
+                    "dropped": b["dropped"],
+                }
+                for w, b in self._trace_buffers.items()
+            }
+        return {
+            "processes": processes,
+            # The master's own spans (rpc.server, dispatcher lease events)
+            # — already on the reference clock every offset aims at.
+            "master_events": trace.default().export(),
+            "master_dropped": trace.default().dropped,
+            "master_now_us": trace.now_us(),
+        }
 
     def _maybe_write_eval_metrics(self) -> None:
         """Record each completed eval round's aggregate exactly once.  The
@@ -488,10 +599,16 @@ class MasterServicer:
         # (their reports are rank-0-gated away); slot update only, no
         # metrics-stream mirror — heartbeats arrive every poll interval.
         self._record_phase_times(req, stream=False)
+        # Trace slices ride the heartbeat (the pull path's supply side).
+        self._record_trace(req)
         resp = {
             "version": self.rendezvous.heartbeat(
                 req["worker_id"], req.get("version")
-            )
+            ),
+            # Master clock stamp for the worker's RTT-midpoint clock-offset
+            # estimate (clients measure t0/t1 locally around this RPC);
+            # cheap enough to ride every beat unconditionally.
+            "server_ts_us": trace.now_us(),
         }
         # Eval-preemption hint (r9): batched leases would otherwise let a
         # worker train up to lease_batch-1 buffered tasks before its next
@@ -520,6 +637,7 @@ class MasterServicer:
 
     def ReportCheckpoint(self, req: dict) -> dict:
         self._record_phase_times(req)
+        self._record_trace(req)
         with self._lock:
             if int(req["step"]) >= int(self._checkpoint["step"] or 0):
                 self._checkpoint = {"path": req["path"], "step": int(req["step"])}
@@ -543,6 +661,9 @@ class MasterServicer:
             status["phase_times"] = {
                 w: dict(p) for w, p in self._phase_times.items()
             }
+            status["phase_counts"] = {
+                w: dict(c) for w, c in self._phase_counts.items()
+            }
         if self.evaluation is not None:
             status["eval_metrics"] = self.evaluation.latest_metrics()
             status["eval_rounds"] = self.evaluation.completed_rounds()
@@ -565,6 +686,7 @@ class MasterServicer:
                 "GetCheckpoint",
                 "ReportCheckpoint",
                 "JobStatus",
+                "DumpTrace",
             )
         }
 
@@ -580,7 +702,13 @@ class MasterServer:
         advertise_host: str = "localhost",
     ):
         self.servicer = servicer
-        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+        # Message cap raised on both sides (GRPC_MESSAGE_OPTIONS): the
+        # DumpTrace response can carry several full per-process trace
+        # rings — far past the 4 MB control-plane default.
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            options=GRPC_MESSAGE_OPTIONS,
+        )
         self._server.add_generic_rpc_handlers(
             (
                 make_generic_handler(

@@ -18,7 +18,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
-from elasticdl_tpu.common import locksan
+from elasticdl_tpu.common import locksan, trace
 from elasticdl_tpu.data.reader import Shard
 
 TASK_TRAINING = "training"
@@ -227,6 +227,14 @@ class TaskDispatcher:
                     task, worker_id, self._clock()
                 )
                 tasks.append(task)
+        if tasks:
+            # Lease lifecycle, instant-event form (non-blocking ring append
+            # — hot-path legal): handout -> report/requeue/recover, so the
+            # merged trace shows which worker held which task when.
+            trace.instant(
+                "lease:handout", cat="lease", worker=worker_id,
+                tasks=[t.task_id for t in tasks],
+            )
         self._fire_epoch_end()
         return tasks
 
@@ -249,6 +257,10 @@ class TaskDispatcher:
         poison-abandon a healthy task: with batched leases a task can sit
         in some worker's buffer across max_task_retries separate scale
         events without ever having run."""
+        trace.instant(
+            "lease:report", cat="lease", task=task_id, worker=worker_id,
+            success=success, requeue=requeue_only,
+        )
         with self._lock:
             entry = self._doing.pop(task_id, None)
             if entry is None:
@@ -289,7 +301,12 @@ class TaskDispatcher:
                 del self._doing[task.task_id]
                 if not self._stopped:
                     self._todo.appendleft(task)
-            return lost
+        if lost:
+            trace.instant(
+                "lease:recover", cat="lease", worker=worker_id,
+                tasks=[t.task_id for t in lost],
+            )
+        return lost
 
     def _requeue_timed_out(self) -> None:
         now = self._clock()
@@ -302,6 +319,7 @@ class TaskDispatcher:
             task = self._doing.pop(tid).task
             if not self._stopped:
                 self._todo.appendleft(task)
+            trace.instant("lease:timeout", cat="lease", task=tid)
 
     def stop(self) -> None:
         """Stop handing out new tasks (reference: --max_steps reached).

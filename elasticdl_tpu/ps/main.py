@@ -42,6 +42,14 @@ logger = get_logger("ps.main")
 def main(argv: Optional[List[str]] = None) -> int:
     config = JobConfig.from_env()
     set_level(config.log_level)
+    if config.trace:
+        # PS-shard spans (ps:pull / ps:push_grad server halves) record
+        # locally; the dump tool reaches them via the shard's own process
+        # buffer only if shipped — PS pods have no heartbeat channel, so
+        # their window is in-process observability (logs/debug) for now.
+        from elasticdl_tpu.common import trace as _trace
+
+        _trace.configure(enabled=True, capacity=config.trace_buffer_events)
 
     slot = int(os.environ.get("ELASTICDL_WORKER_SLOT", "0"))
     ports = [

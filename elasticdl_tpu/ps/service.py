@@ -58,7 +58,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import grpc
 import numpy as np
 
-from elasticdl_tpu.common import locksan
+from elasticdl_tpu.common import locksan, trace
 from elasticdl_tpu.common.log_utils import get_logger
 
 logger = get_logger("ps.service")
@@ -327,13 +327,19 @@ class PSServer:
         store = self._store_for(meta)
         ids = self._require(arrays, "ids", np.int64)
         lock = self._locks[meta["table"]]
-        with lock.read():
-            # Fast path: all rows exist — concurrent with other pulls.
-            rows, missing = store.try_pull(ids)
-        if missing:
-            # New ids materialize rows (mutation): exclusive per-table.
-            with lock.write():
-                rows = store.pull(ids)
+        # Span via the non-blocking ring API only (trace-discipline): the
+        # PS read is the serving/training tiers' shared tail-latency
+        # suspect, so its server-side wall is first-class trace data.
+        with trace.span(
+            "ps:pull", cat="ps.server", table=meta["table"], n_ids=int(ids.size)
+        ):
+            with lock.read():
+                # Fast path: all rows exist — concurrent with other pulls.
+                rows, missing = store.try_pull(ids)
+            if missing:
+                # New ids materialize rows (mutation): exclusive per-table.
+                with lock.write():
+                    rows = store.pull(ids)
         return {}, {"rows": rows}
 
     # hot-path: the per-step gradient apply
@@ -346,8 +352,12 @@ class PSServer:
                 f"grads shape {grads.shape} != ids {ids.shape} + (dim "
                 f"{store.dim},)"
             )
-        with self._locks[meta["table"]].write():
-            store.push_grad(ids, grads)
+        with trace.span(
+            "ps:push_grad", cat="ps.server", table=meta["table"],
+            n_ids=int(ids.size),
+        ):
+            with self._locks[meta["table"]].write():
+                store.push_grad(ids, grads)
         return {"applied": int(ids.size)}, {}
 
     @contextlib.contextmanager
@@ -608,6 +618,13 @@ class RemoteEmbeddingStore:
             except grpc.RpcError as e:
                 if e.code() not in self.TRANSIENT_CODES:
                     raise
+                # The retry count is trace data: a pull span whose wall
+                # includes shard-relaunch backoffs is only explicable with
+                # the retries visible beside it.
+                trace.instant(
+                    "ps:retry", cat="ps.client", table=self.table,
+                    attempt=i + 1, code=str(e.code()),
+                )
                 logger.warning(
                     "PS call failed (%s), retry %d/%d in %.0fs",
                     e.code(), i + 1, len(self.RETRY_BACKOFFS_S), backoff,
@@ -681,14 +698,22 @@ class RemoteEmbeddingStore:
         ids = np.ascontiguousarray(ids, np.int64)
         flat = ids.ravel()
         out = np.empty((flat.size, self.dim), np.float32)
-        if self.num_shards == 1:
-            _, arrays = self._call_shard(0, "Pull", {"ids": flat})
-            out[:] = arrays["rows"]
-            return out.reshape(ids.shape + (self.dim,))
-        parts = self._partition(flat)
-        work = [(s, {"ids": flat[idx]}) for s, idx in enumerate(parts) if idx.size]
-        for s, _, arrays in self._fan_out("Pull", work):
-            out[parts[s]] = arrays["rows"]
+        with trace.span(
+            "ps:pull", cat="ps.client", table=self.table,
+            n_ids=int(flat.size), shards=self.num_shards,
+        ):
+            if self.num_shards == 1:
+                _, arrays = self._call_shard(0, "Pull", {"ids": flat})
+                out[:] = arrays["rows"]
+                return out.reshape(ids.shape + (self.dim,))
+            parts = self._partition(flat)
+            work = [
+                (s, {"ids": flat[idx]})
+                for s, idx in enumerate(parts)
+                if idx.size
+            ]
+            for s, _, arrays in self._fan_out("Pull", work):
+                out[parts[s]] = arrays["rows"]
         return out.reshape(ids.shape + (self.dim,))
 
     def push_grad(self, ids: np.ndarray, grads: np.ndarray) -> None:
@@ -696,16 +721,20 @@ class RemoteEmbeddingStore:
         grads = np.ascontiguousarray(grads, np.float32).reshape(
             ids.size, self.dim
         )
-        if self.num_shards == 1:
-            self._call_shard(0, "PushGrad", {"ids": ids, "grads": grads})
-            return
-        parts = self._partition(ids)
-        work = [
-            (s, {"ids": ids[idx], "grads": grads[idx]})
-            for s, idx in enumerate(parts)
-            if idx.size
-        ]
-        self._fan_out("PushGrad", work)
+        with trace.span(
+            "ps:push_grad", cat="ps.client", table=self.table,
+            n_ids=int(ids.size), shards=self.num_shards,
+        ):
+            if self.num_shards == 1:
+                self._call_shard(0, "PushGrad", {"ids": ids, "grads": grads})
+                return
+            parts = self._partition(ids)
+            work = [
+                (s, {"ids": ids[idx], "grads": grads[idx]})
+                for s, idx in enumerate(parts)
+                if idx.size
+            ]
+            self._fan_out("PushGrad", work)
 
     # -- checkpoint fan-out (each shard dumps/loads its own slice) --
 

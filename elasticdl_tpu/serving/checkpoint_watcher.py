@@ -22,6 +22,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, Dict, Optional
 
+from elasticdl_tpu.common import trace
 from elasticdl_tpu.common.checkpoint import read_manifest
 from elasticdl_tpu.common.log_utils import get_logger
 
@@ -79,6 +80,7 @@ class CheckpointWatcher:
             )
             return False
         self._applied = step
+        trace.instant("serving:hot_reload", cat="serving", step=step)
         logger.info("hot reload applied: serving checkpoint step %d", step)
         return True
 

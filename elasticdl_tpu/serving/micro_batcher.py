@@ -36,7 +36,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from elasticdl_tpu.common import locksan
+from elasticdl_tpu.common import locksan, trace
 from elasticdl_tpu.common.log_utils import get_logger
 
 logger = get_logger("serving.micro_batcher")
@@ -299,17 +299,26 @@ class MicroBatcher:
         failures resolve every request of THIS flush with the error and the
         flusher survives — one poisoned batch must not wedge the server."""
         try:
-            batch = {k: t.copy() for k, t in self._template.items()}
-            mask = np.zeros((self.max_batch,), np.float32)
-            mask[:n_real] = 1.0
-            batch[MASK_KEY] = mask
-            lo = 0
-            for h in take:
-                for k in self._template:
-                    arr = np.asarray(h.features[k], self._template[k].dtype)
-                    batch[k][lo : lo + h.count] = arr
-                lo += h.count
-            outputs, meta = self._runner(batch, n_real)
+            # The flush span IS the serving tier's unit of work: request
+            # count + real/padded rows beside its wall make batching
+            # efficiency (and the padding tax) visible in the merged trace.
+            with trace.span(
+                "serving:flush", cat="serving", n_requests=len(take),
+                n_real=n_real, n_padded=self.max_batch - n_real,
+            ):
+                batch = {k: t.copy() for k, t in self._template.items()}
+                mask = np.zeros((self.max_batch,), np.float32)
+                mask[:n_real] = 1.0
+                batch[MASK_KEY] = mask
+                lo = 0
+                for h in take:
+                    for k in self._template:
+                        arr = np.asarray(
+                            h.features[k], self._template[k].dtype
+                        )
+                        batch[k][lo : lo + h.count] = arr
+                    lo += h.count
+                outputs, meta = self._runner(batch, n_real)
             lo = 0
             for h in take:
                 h._resolve(_slice_outputs(outputs, lo, lo + h.count), meta)

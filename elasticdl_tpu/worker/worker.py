@@ -24,7 +24,7 @@ from typing import Any, Dict, Iterable, List, NamedTuple, Optional, Sequence
 import jax
 import numpy as np
 
-from elasticdl_tpu.common import locksan
+from elasticdl_tpu.common import locksan, trace
 from elasticdl_tpu.common.checkpoint import CheckpointManager
 from elasticdl_tpu.common.config import JobConfig
 from elasticdl_tpu.common.log_utils import get_logger
@@ -224,6 +224,17 @@ class Worker:
         # train-job artifact can attribute the job-vs-bench gap to named
         # phases.
         self.phases = PhaseTimers()
+        # grafttrace: --trace turns the per-process span recorder on (every
+        # phase above doubles as a span; RPC boundaries, gang waits and
+        # elastic transitions add their own).  Bounded slices ship to the
+        # master on the heartbeat/report channel; the RTT-midpoint clock
+        # offset below is measured against the Heartbeat server stamp so
+        # tools/trace_dump.py can align this process onto the master clock.
+        if config.trace:
+            trace.configure(
+                enabled=True, capacity=config.trace_buffer_events
+            )
+        self._trace_clock_offset_us: Optional[float] = None
 
         if config.checkpoint_dir:
             self._ckpt = CheckpointManager(
@@ -335,6 +346,10 @@ class Worker:
                     # A broken runtime must not block the restart itself —
                     # the periodic checkpoint covers the resume.
                     logger.exception("pre-restart snapshot failed; restarting anyway")
+            trace.instant(
+                "elastic:restart_required", cat="elastic",
+                version=version, world=world,
+            )
             raise WorkerRestartRequired(
                 f"membership v{version}: world changed to {world} hosts"
             )
@@ -361,6 +376,10 @@ class Worker:
             logger.info(
                 "membership v%d -> re-forming mesh to %d devices",
                 version, mesh.devices.size,
+            )
+            trace.instant(
+                "elastic:reform", cat="elastic",
+                version=version, devices=int(mesh.devices.size),
             )
             self.trainer.set_mesh(mesh)
             self._replace_state()
@@ -492,6 +511,23 @@ class Worker:
         )
         return True
 
+    def _trace_payload(self) -> Optional[dict]:
+        """One bounded slice of this process's trace ring for the
+        heartbeat/report channel, with the latest clock-offset estimate —
+        or None when tracing is off or the buffer is empty.  Draining here
+        (a control-plane boundary, NOT a ``# hot-path`` function) is
+        exactly the split the trace-discipline lint rule enforces."""
+        rec = trace.default()
+        if not rec.enabled:
+            return None
+        events = rec.drain_slice(trace.SHIP_BATCH)
+        if not events:
+            return None
+        payload: dict = {"events": events, "dropped": rec.dropped}
+        if self._trace_clock_offset_us is not None:
+            payload["clock_offset_us"] = self._trace_clock_offset_us
+        return payload
+
     def _check_membership(self) -> None:
         # The heartbeat carries the version this worker has APPLIED: the
         # master's lockstep task log withholds collective tasks until every
@@ -505,7 +541,20 @@ class Worker:
             # and CAN diverge) was invisible to the very instrument built
             # to see it.
             hb["phase_times"] = self.phases.snapshot()
+            hb["phase_counts"] = self.phases.counts()
+        tp = self._trace_payload()
+        if tp is not None:
+            hb["trace"] = tp
+        t0_us = trace.now_us()
         resp = self.master.call("Heartbeat", hb)
+        t1_us = trace.now_us()
+        server_ts = resp.get("server_ts_us")
+        if server_ts is not None:
+            # RTT-midpoint clock alignment: assume the server stamped its
+            # clock halfway through the round trip, so (master - worker) ~=
+            # server_ts - (t0+t1)/2.  Error is bounded by RTT asymmetry —
+            # microseconds in-cluster, and the next beat refreshes it.
+            self._trace_clock_offset_us = server_ts - (t0_us + t1_us) / 2.0
         if not self._group_mode and resp.get("draining"):
             # Max-steps drain: buffered leases AND undispatched prepped
             # tasks carry no device work yet — return them all (requeue-
@@ -587,14 +636,25 @@ class Worker:
         with self._ckpt_lock:
             self._last_ckpt_step = step
         self.master.call(
-            "ReportCheckpoint",
-            {
-                "path": self._ckpt.directory,
-                "step": step,
-                "worker_id": self.worker_id,
-                "phase_times": self.phases.snapshot(),
-            },
+            "ReportCheckpoint", self._checkpoint_report(step)
         )
+
+    def _checkpoint_report(self, step: int) -> dict:
+        """The ReportCheckpoint payload: path/step plus the phase snapshot
+        AND a trace slice — checkpoint reports are the "Report" half of the
+        heartbeat/report trace-shipping channel (the last word a finishing
+        worker sends, so the tail of its buffer rides out here)."""
+        report = {
+            "path": self._ckpt.directory,
+            "step": step,
+            "worker_id": self.worker_id,
+            "phase_times": self.phases.snapshot(),
+            "phase_counts": self.phases.counts(),
+        }
+        tp = self._trace_payload()
+        if tp is not None:
+            report["trace"] = tp
+        return report
 
     def _join_ckpt(self, timeout: float = None) -> None:
         with self._ckpt_lock:
@@ -693,13 +753,7 @@ class Worker:
                         # host shards dumped: rank 0 publishes for serving.
                         self._ckpt.publish(step)
                         self.master.call(
-                            "ReportCheckpoint",
-                            {
-                                "path": self._ckpt.directory,
-                                "step": step,
-                                "worker_id": self.worker_id,
-                                "phase_times": self.phases.snapshot(),
-                            },
+                            "ReportCheckpoint", self._checkpoint_report(step)
                         )
             except Exception:
                 logger.exception(
@@ -733,6 +787,7 @@ class Worker:
         Runs on the preemption thread, not in the signal handler frame.
         """
         self._preempting = True  # parks the task loop at its next boundary
+        trace.instant("elastic:preempt", cat="elastic", rank=self._rank)
         if (
             self._group_mode
             or self._rank != 0
@@ -1237,8 +1292,11 @@ class Worker:
     # hot-path: the report RPC is accounted under the metrics phase
     def _report_result(self, report: dict) -> None:
         """ReportTaskResult with the cumulative phase decomposition riding
-        along (the master's JobStatus and the train-job artifact read it)."""
+        along (the master's JobStatus and the train-job artifact read it).
+        ``phase_counts`` rides beside the seconds so per-phase AVERAGES are
+        computable downstream, not just cumulative sums."""
         report["phase_times"] = self.phases.snapshot()
+        report["phase_counts"] = self.phases.counts()
         with self.phases.phase("metrics"):
             self.master.call("ReportTaskResult", report)
 
@@ -1493,15 +1551,25 @@ class Worker:
             # devices); the master's group log keys entries by seq, and the
             # lease batches the log walk.
             with self.phases.phase("lease_wait"):
-                resp = self.master.call(
-                    "GetGroupTask",
-                    {
-                        "worker_id": self.worker_id,
-                        "seq": self._task_seq,
-                        "version": self._membership_version,
-                        "lease": n,
-                    },
-                )
+                # The gang-boundary wait, as its own span per rank: in
+                # lockstep mode every rank crosses this boundary at the
+                # same seq, so per-rank span totals are directly
+                # comparable — the straggler report's skew input
+                # (tools/straggler_report.py).
+                with trace.span(
+                    "gang_boundary", cat="gang",
+                    seq=self._task_seq, rank=self._rank,
+                    version=self._membership_version,
+                ):
+                    resp = self.master.call(
+                        "GetGroupTask",
+                        {
+                            "worker_id": self.worker_id,
+                            "seq": self._task_seq,
+                            "version": self._membership_version,
+                            "lease": n,
+                        },
+                    )
             if resp.get("stale"):
                 return resp
             entries = resp.get("entries") or [
@@ -1614,6 +1682,30 @@ class Worker:
                 ),
                 np.concatenate(outs, axis=0),
             )
+
+    def _ship_trace_tail(self, max_beats: int = 8) -> None:
+        """Drain the remaining trace buffer to the master over bounded
+        extra heartbeats (job end / final settle).  Best-effort: a dead
+        master just loses the tail — the job is over either way."""
+        rec = trace.default()
+        for _ in range(max_beats):
+            if not rec.enabled:
+                return
+            tp = self._trace_payload()
+            if tp is None:
+                return
+            try:
+                self.master.call(
+                    "Heartbeat",
+                    {
+                        "worker_id": self.worker_id,
+                        "version": self._membership_version,
+                        "trace": tp,
+                    },
+                )
+            except Exception:
+                logger.info("trace tail ship failed; dropping the tail")
+                return
 
     # ---- main loop ----
 
@@ -1904,14 +1996,14 @@ class Worker:
                     self._ckpt.publish(step)
                 if self._rank == 0:
                     self.master.call(
-                        "ReportCheckpoint",
-                        {
-                            "path": self._ckpt.directory,
-                            "step": step,
-                            "worker_id": self.worker_id,
-                            "phase_times": self.phases.snapshot(),
-                        },
+                        "ReportCheckpoint", self._checkpoint_report(step)
                     )
+        # Ship the trace tail: events recorded since the last heartbeat
+        # would otherwise die with this process (the merged view of a
+        # COMPLETED job wants its final tasks too).  Inside a control
+        # phase boundary: these are deliberate, accounted job-end RPCs.
+        with self.phases.phase("control"):
+            self._ship_trace_tail()
         return {
             "tasks_done": self._tasks_done,
             # graftlint: allow[hot-path-sync] job-end summary; everything is already settled

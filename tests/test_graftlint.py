@@ -860,6 +860,89 @@ def test_only_paths_scopes_parse_errors_too(tmp_path):
     assert findings == []
 
 
+# ---- trace-discipline ----
+
+TRACE_SEEDED = """
+    from elasticdl_tpu.common import trace
+
+    class Worker:
+        # hot-path: the steady-state task loop
+        def poll(self):
+            rec = trace.default()
+            rec.instant("tick", cat="loop")
+            return rec.drain_slice(512)  # export from the hot path: finding
+"""
+
+TRACE_CLEAN = """
+    from elasticdl_tpu.common import trace
+
+    class Worker:
+        # hot-path: the steady-state task loop
+        def poll(self):
+            with trace.span("poll", cat="loop"):
+                trace.instant("tick", cat="loop")
+
+        def ship(self):
+            # Not hot-path: draining from a control-plane boundary is the
+            # intended pattern.
+            return trace.default().drain_slice(512)
+"""
+
+
+def test_trace_discipline_seeded_and_clean():
+    from elasticdl_tpu.analysis.trace_discipline import TraceDisciplinePass
+
+    findings = _lint(TRACE_SEEDED, [TraceDisciplinePass()])
+    assert _rules(findings) == {"trace-discipline"}
+    assert len(findings) == 1
+    assert _lint(TRACE_CLEAN, [TraceDisciplinePass()]) == []
+
+
+def test_trace_discipline_flags_export_and_chrome_events():
+    from elasticdl_tpu.analysis.trace_discipline import TraceDisciplinePass
+
+    src = """
+        class W:
+            # hot-path
+            def step(self, rec):
+                rec.export()
+                rec.chrome_events()
+    """
+    findings = _lint(src, [TraceDisciplinePass()])
+    assert len(findings) == 2
+
+
+def test_trace_discipline_ignores_unrelated_export():
+    from elasticdl_tpu.analysis.trace_discipline import TraceDisciplinePass
+
+    src = """
+        class W:
+            # hot-path
+            def step(self, model):
+                model.export()  # not a trace recorder: no finding
+    """
+    assert _lint(src, [TraceDisciplinePass()]) == []
+
+
+def test_trace_discipline_waivable_and_exempts_error_paths():
+    from elasticdl_tpu.analysis.trace_discipline import TraceDisciplinePass
+
+    src = """
+        from elasticdl_tpu.common import trace
+
+        class W:
+            # hot-path
+            def step(self, rec):
+                # graftlint: allow[trace-discipline] deliberate debug drain
+                rec.drain_slice(8)
+                try:
+                    pass
+                except Exception:
+                    rec.drain_slice(8)  # error path: exempt
+    """
+    assert _lint(src, [TraceDisciplinePass()]) == []
+
+
 # ---- the repo-wide gate ----
 
 def test_repo_lints_clean():

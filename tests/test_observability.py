@@ -43,6 +43,43 @@ def test_read_metrics_missing_dir(tmp_path):
     assert read_metrics(str(tmp_path / "nope")) == []
 
 
+def test_metrics_writer_holds_one_append_handle(tmp_path):
+    """One handle for the stream's life (the old idiom reopened per
+    record); records are flushed so a concurrent reader sees them."""
+    writer = MetricsWriter(str(tmp_path), tensorboard=False)
+    f = writer._f
+    writer.write("train", 1, {"loss": 1.0})
+    writer.write("train", 2, {"loss": 0.5})
+    assert writer._f is f  # same handle across records
+    # Flushed: visible to an independent reader before close().
+    assert len(read_metrics(str(tmp_path))) == 2
+    writer.close()
+    assert writer._f is None
+    # A report racing close() reopens instead of crashing the handler.
+    writer.write("train", 3, {"loss": 0.25})
+    writer.close()
+    assert len(read_metrics(str(tmp_path))) == 3
+
+
+def test_read_metrics_tolerates_torn_final_line(tmp_path):
+    writer = MetricsWriter(str(tmp_path), tensorboard=False)
+    writer.write("train", 1, {"loss": 1.0})
+    writer.write("train", 2, {"loss": 0.5})
+    writer.close()
+    path = tmp_path / "metrics.jsonl"
+    # Simulate a crash mid-append: the final line is torn.
+    with open(path, "a") as f:
+        f.write('{"ts": 3, "kind": "tra')
+    records = read_metrics(str(tmp_path))
+    assert [r["step"] for r in records] == [1, 2]
+    # Garbage EARLIER in the stream is corruption, not a crash tail: raise.
+    lines = path.read_text().splitlines()
+    lines[0] = "not json {{{"
+    path.write_text("\n".join(lines) + "\n")
+    with pytest.raises(Exception):
+        read_metrics(str(tmp_path))
+
+
 def _job(tmp_path, **cfg):
     train = str(tmp_path / "train.rio")
     val = str(tmp_path / "val.rio")
@@ -104,6 +141,26 @@ def test_master_writes_train_and_eval_metrics(tmp_path, devices):
     # eval rounds recorded once each
     eval_records = [r for r in records if r["kind"] == "eval"]
     assert len(eval_records) == evaluation.completed_rounds()
+
+
+def test_phase_counts_ride_reports_into_job_status(tmp_path, devices):
+    """PhaseTimers.counts() rides ReportTaskResult/ReportCheckpoint beside
+    phase_times (additive optional field), and JobStatus republishes it —
+    per-phase AVERAGES become computable from the same artifact that held
+    only cumulative sums."""
+    config, dispatcher, evaluation, reader, spec = _job(tmp_path)
+    servicer = MasterServicer(dispatcher)
+    worker = Worker(config, DirectMasterProxy(servicer), reader, spec=spec)
+    worker.run()
+    status = servicer.JobStatus({})
+    counts = status["phase_counts"].get(worker.worker_id)
+    times = status["phase_times"].get(worker.worker_id)
+    assert counts and times
+    # Counts key the same phases the seconds do, and each recorded phase
+    # entered at least once — total/count is a well-defined mean.
+    for name, seconds in times.items():
+        assert counts.get(name, 0) >= 1, name
+        assert seconds >= 0
 
 
 def test_worker_profiler_trace(tmp_path, devices):

@@ -51,12 +51,32 @@ def code_rev(repo: Optional[str] = None) -> str:
         return ""
 
 
-def latency_stats(samples_ms: Sequence[float], prefix: str = "") -> dict:
+#: Shared log-spaced histogram bucket edges (MILLISECONDS) for
+#: ``latency_stats(..., buckets=True)``.  One FIXED grid across every
+#: artifact (serving_bench, ps_bench, straggler_report) so tail shapes are
+#: comparable file to file and round to round — per-run adaptive edges
+#: would make two artifacts' histograms incomparable.
+DEFAULT_BUCKET_EDGES_MS = (
+    0.1, 0.2, 0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 50.0,
+    100.0, 200.0, 500.0, 1000.0, 2000.0, 5000.0, 10000.0,
+)
+
+
+def latency_stats(
+    samples_ms: Sequence[float], prefix: str = "", buckets=None
+) -> dict:
     """p50/p99/mean/max over per-request latencies in MILLISECONDS — the
-    one definition both latency benches (ps_bench, serving_bench) stamp,
-    so percentile conventions cannot drift per tool.  Empty input returns
-    {} (a point with zero completed requests has no latency distribution;
-    callers report their error tallies instead).
+    one definition every latency consumer (ps_bench, serving_bench,
+    straggler_report) stamps, so percentile conventions cannot drift per
+    tool.  Empty input returns {} (a point with zero completed requests has
+    no latency distribution; callers report their error tallies instead).
+
+    ``buckets``: True for the shared ``DEFAULT_BUCKET_EDGES_MS`` grid, or
+    an explicit ascending edge sequence — adds ``{prefix}hist`` with
+    ``edges_ms`` and ``counts`` (``len(edges)+1`` entries: counts[i] holds
+    samples in ``(edges[i-1], edges[i]]`` with counts[0] the under-first-
+    edge bin and counts[-1] the overflow), so artifacts carry the TAIL
+    SHAPE, not just two percentile points.
     """
     if not samples_ms:
         return {}
@@ -64,12 +84,25 @@ def latency_stats(samples_ms: Sequence[float], prefix: str = "") -> dict:
                         # (graftlint's artifact path must cost milliseconds)
 
     arr = np.asarray(samples_ms, np.float64)
-    return {
+    out = {
         f"{prefix}p50_ms": round(float(np.percentile(arr, 50)), 2),
         f"{prefix}p99_ms": round(float(np.percentile(arr, 99)), 2),
         f"{prefix}mean_ms": round(float(arr.mean()), 2),
         f"{prefix}max_ms": round(float(arr.max()), 2),
     }
+    if buckets is not None and buckets is not False:
+        edges = (
+            DEFAULT_BUCKET_EDGES_MS
+            if buckets is True
+            else tuple(float(e) for e in buckets)
+        )
+        idx = np.searchsorted(np.asarray(edges, np.float64), arr, side="left")
+        counts = np.bincount(idx, minlength=len(edges) + 1)
+        out[f"{prefix}hist"] = {
+            "edges_ms": list(edges),
+            "counts": [int(c) for c in counts],
+        }
+    return out
 
 
 def write_artifact(

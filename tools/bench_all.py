@@ -248,7 +248,34 @@ def main() -> None:
         "its own SERVE artifact — the r10 latency surface alongside "
         "examples/sec",
     )
+    ap.add_argument(
+        "--trace-smoke", action="store_true",
+        help="run ONLY the grafttrace overhead smoke: the ingest bench's "
+        "--trace A/B (recorder off vs on, same workload) must land under "
+        "2%% throughput delta — the recorded guarantee that tracing a "
+        "production job is safe (docs/observability.md)",
+    )
     args = ap.parse_args()
+    if args.trace_smoke:
+        # Host-only (no chip probe): the smoke measures the recorder, not
+        # the accelerator, and must run on any box.
+        from tools.ingest_bench import trace_overhead_ab
+
+        result = trace_overhead_ab(
+            lambda m: print(f"[trace-smoke] {m}", file=sys.stderr, flush=True)
+        )
+        print(json.dumps(result), flush=True)
+        if result["overhead_pct"] >= 2.0:
+            print(
+                f"[trace-smoke] FAIL: {result['overhead_pct']}% overhead "
+                ">= 2% budget", file=sys.stderr,
+            )
+            raise SystemExit(1)
+        print(
+            f"[trace-smoke] PASS: {result['overhead_pct']}% overhead "
+            "< 2% budget", file=sys.stderr,
+        )
+        return
     from elasticdl_tpu.common.platform import probe_devices
 
     # Killable-subprocess probe before the first in-process backend touch:

@@ -61,16 +61,42 @@ def _wire_bytes(batch: dict) -> int:
     return sum(np.asarray(v).nbytes for v in batch.values())
 
 
-def _thread_sweep(max_threads: int, out: str, log) -> None:
-    """Parallel-ingest sweep: the worker's chunked read+decode at pool
-    widths 1..max_threads over task-sized ranges (the e2e shard size), with
-    per-width examples/sec and speedup vs serial.  Mirrors
-    Worker._prep_fused_host's chunk plan exactly (minibatch-aligned spans,
-    read_records_packed + criteo_feed_pre per chunk) minus the stacking,
-    so the number is comparable to the r5 ``host_side_examples_per_sec``."""
-    from elasticdl_tpu.data.ingest_pool import IngestPool, plan_chunks
+def _chunked_task(reader, path, pool, start: int, task_records: int,
+                  phases=None) -> None:
+    """ONE worker-shaped ingest task: minibatch-aligned chunk plan + pooled
+    bulk read + preprocessed decode — Worker._prep_fused_host's hot path
+    minus the stacking.  The width sweep and the trace-overhead A/B both
+    measure THIS (one definition, so neither can silently drift onto a
+    different workload than the other claims comparability with);
+    ``phases`` wraps each chunk decode in the PhaseTimers accounting
+    boundary the A/B needs (the boundary that doubles as a trace span)."""
+    import contextlib
+
     from elasticdl_tpu.data.codecs import criteo_feed_pre
-    from elasticdl_tpu.data.reader import Shard, create_data_reader
+    from elasticdl_tpu.data.ingest_pool import plan_chunks
+    from elasticdl_tpu.data.reader import Shard
+
+    def _decode_chunk(span):
+        ctx = (
+            phases.phase("decode_parallel")
+            if phases is not None
+            else contextlib.nullcontext()
+        )
+        with ctx:
+            recs = reader.read_records_packed(Shard(path, span[0], span[1]))
+            return criteo_feed_pre(recs, BUCKETS)
+
+    chunks = plan_chunks(start, start + task_records, MINIBATCH, pool.threads)
+    pool.map_ordered(_decode_chunk, chunks)
+
+
+def _thread_sweep(max_threads: int, out: str, log) -> None:
+    """Parallel-ingest sweep: the worker's chunked read+decode
+    (``_chunked_task``) at pool widths 1..max_threads over task-sized
+    ranges (the e2e shard size), with per-width examples/sec and speedup
+    vs serial — comparable to the r5 ``host_side_examples_per_sec``."""
+    from elasticdl_tpu.data.ingest_pool import IngestPool
+    from elasticdl_tpu.data.reader import create_data_reader
     from tools.bench_e2e import _dataset
 
     task_records = MINIBATCH * 8  # the e2e bench's records-per-task
@@ -86,24 +112,17 @@ def _thread_sweep(max_threads: int, out: str, log) -> None:
     rows = []
     for width in widths:
         pool = IngestPool(width)
-
-        def _decode_chunk(span):
-            recs = reader.read_records_packed(
-                Shard(path, span[0], span[1])
-            )
-            return criteo_feed_pre(recs, BUCKETS)
-
         best = float("inf")
-        for _ in range(REPEATS):
-            t0 = time.perf_counter()
-            for b in range(n_tasks):
-                start = b * task_records
-                chunks = plan_chunks(
-                    start, start + task_records, MINIBATCH, pool.threads
-                )
-                pool.map_ordered(_decode_chunk, chunks)
-            best = min(best, time.perf_counter() - t0)
-        pool.shutdown()
+        try:
+            for _ in range(REPEATS):
+                t0 = time.perf_counter()
+                for b in range(n_tasks):
+                    _chunked_task(
+                        reader, path, pool, b * task_records, task_records
+                    )
+                best = min(best, time.perf_counter() - t0)
+        finally:
+            pool.shutdown()
         eps = task_records * n_tasks / best
         rows.append({
             "threads": width,
@@ -131,6 +150,122 @@ def _thread_sweep(max_threads: int, out: str, log) -> None:
     print(json.dumps(rows), flush=True)
 
 
+def trace_overhead_ab(log=None) -> dict:
+    """The --trace overhead measurement on the ingest workload (chunk plan
+    + pooled read/decode, every chunk inside a ``PhaseTimers`` phase — the
+    accounting boundary that doubles as a trace span when the recorder is
+    on).  Two numbers come back:
+
+    - ``overhead_pct`` — the ASSERTABLE bound: events-per-run counted from
+      the real traced workload x per-event cost measured in isolation
+      (100k-rep microbench), over the measured run wall.  Deterministic
+      arithmetic over stable measurements.
+    - ``ab_delta_pct`` — the raw interleaved wall-clock A/B, recorded for
+      transparency.  On this shared 2-core box the run-to-run weather is
+      +/-10-25% (co-tenant CPU steal; even process_time swings with cache
+      pollution) while the true effect is ~0.1%, so the raw delta is a
+      weather report — measured and stamped, never asserted on.
+
+    The smoke gate (<2%, asserted by bench_all --trace-smoke and stamped
+    into TRACE_r12.json) is what makes "--trace on a production job is
+    safe" a recorded number instead of a hope."""
+    log = log or (lambda m: print(f"[ingest] {m}", file=sys.stderr, flush=True))
+    import time as _time
+
+    from elasticdl_tpu.common import trace
+    from elasticdl_tpu.common.metrics import PhaseTimers
+    from elasticdl_tpu.data.ingest_pool import IngestPool
+    from elasticdl_tpu.data.reader import create_data_reader
+    from tools.bench_e2e import _dataset
+
+    task_records = MINIBATCH * 8
+    n_tasks = 6
+    path = _dataset()
+    reader = create_data_reader(path)
+    pool = IngestPool(min(2, os.cpu_count() or 1))
+    phases = PhaseTimers()
+
+    def _run_once() -> float:
+        t0 = _time.perf_counter()
+        for b in range(n_tasks):
+            with phases.phase("prep_wait"):
+                _chunked_task(
+                    reader, path, pool, b * task_records, task_records,
+                    phases=phases,
+                )
+            # The control-plane event load of one task boundary (lease/
+            # report instants) rides along so the accounting covers
+            # instants too, not just phase spans.
+            trace.instant("bench:task", cat="lease", task=b)
+        return _time.perf_counter() - t0
+
+    was_enabled = trace.enabled()
+    try:
+        _run_once()  # warm the page cache outside every measurement
+        # Traced run: count the REAL event load and the wall it rode on.
+        trace.configure(enabled=True, capacity=65536)
+        trace.default().clear()
+        traced_wall = _run_once()
+        events = trace.default().export()
+        n_spans = sum(1 for e in events if e.get("ph") == "X")
+        n_instants = len(events) - n_spans
+        # Interleaved wall A/B (best-of per arm), recorded as-is.
+        best_off = float("inf")
+        best_on = traced_wall
+        for _ in range(3):
+            trace.configure(enabled=False)
+            best_off = min(best_off, _run_once())
+            trace.configure(enabled=True)
+            trace.default().clear()
+            best_on = min(best_on, _run_once())
+        # Primitive costs, isolated: 100k span enter/exits and instants.
+        n = 100_000
+        t0 = _time.perf_counter()
+        for _ in range(n):
+            with trace.span("x", cat="bench"):
+                pass
+        span_ns = (_time.perf_counter() - t0) / n * 1e9
+        t0 = _time.perf_counter()
+        for _ in range(n):
+            trace.instant("x", cat="bench")
+        instant_ns = (_time.perf_counter() - t0) / n * 1e9
+        trace.default().clear()
+    finally:
+        trace.configure(enabled=was_enabled)
+        pool.shutdown()
+    event_cost_s = (n_spans * span_ns + n_instants * instant_ns) / 1e9
+    overhead_pct = event_cost_s / traced_wall * 100.0
+    ab_delta_pct = (best_on - best_off) / best_off * 100.0
+    out = {
+        "overhead_pct": round(overhead_pct, 4),
+        "events_per_run": len(events),
+        "spans_per_run": n_spans,
+        "instants_per_run": n_instants,
+        "run_wall_s": round(traced_wall, 4),
+        "span_ns": round(span_ns, 1),
+        "instant_ns": round(instant_ns, 1),
+        "examples_per_sec_trace_on": round(
+            task_records * n_tasks / best_on, 1
+        ),
+        "examples_per_sec_trace_off": round(
+            task_records * n_tasks / best_off, 1
+        ),
+        "ab_delta_pct": round(ab_delta_pct, 2),
+        "ab_note": "raw interleaved wall A/B on a shared box: +/-10-25% "
+                   "co-tenant weather over a ~0.1% true effect — recorded "
+                   "for transparency; overhead_pct (event count x measured "
+                   "per-event cost over run wall) is the assertable bound",
+        "workload": f"{n_tasks} x {task_records}-record criteo tasks, "
+                    f"chunked read+decode on a {pool.threads}-thread pool; "
+                    "spans via PhaseTimers phases + one instant per task",
+    }
+    log(f"trace overhead: {len(events)} events/run x "
+        f"({span_ns:.0f} ns/span, {instant_ns:.0f} ns/instant) over "
+        f"{traced_wall*1e3:.0f} ms = {overhead_pct:.4f}% "
+        f"(raw wall A/B {ab_delta_pct:+.2f}%, weather-dominated)")
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument(
@@ -142,8 +277,25 @@ def main() -> None:
              "(stamps artifacts/INGEST_r09.json) instead of the serial "
              "stage breakdown",
     )
+    ap.add_argument(
+        "--trace-ab", action="store_true",
+        help="run the --trace overhead A/B (recorder off vs on over the "
+             "chunked ingest workload) and print the result JSON",
+    )
     args = ap.parse_args()
     log = lambda m: print(f"[ingest] {m}", file=sys.stderr, flush=True)
+
+    if args.trace_ab:
+        result = trace_overhead_ab(log)
+        if args.out:
+            from tools.artifact import write_artifact
+
+            write_artifact(
+                {"metric": "trace_overhead_ingest_ab", **result},
+                "trace_ab_r12.json", path=args.out, log=log,
+            )
+        print(json.dumps(result), flush=True)
+        return
 
     if args.threads > 0:
         _thread_sweep(

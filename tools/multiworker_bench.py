@@ -172,11 +172,17 @@ _INGEST_RECORDS_PER_TASK = _INGEST_MB * _INGEST_MB_PER_TASK
 
 
 def _run_ingest_fleet(
-    n_workers: int, n_tasks: int, tmp: str, log, platform: str
+    n_workers: int, n_tasks: int, tmp: str, log, platform: str,
+    trace_dump_raw: str = "",
 ) -> dict:
     """One lockstep gang of ``n_workers`` REAL worker processes training
     criteo recordio end to end; returns examples/sec through the gang plus
-    the workers' phase decomposition."""
+    the workers' phase decomposition.
+
+    ``trace_dump_raw``: enable grafttrace on every process (workers via the
+    config bus, the embedded master in-process) and save the raw DumpTrace
+    response there after the job finishes — the supply side of
+    tools/straggler_report.py's gang analysis."""
     from elasticdl_tpu.common.config import JobConfig
     from elasticdl_tpu.data.reader import create_data_reader
     from elasticdl_tpu.data.synthetic import synthetic_criteo
@@ -226,7 +232,14 @@ def _run_ingest_fleet(
         task_pipelining=True,
         checkpoint_steps=0,  # checkpoint wire has its own instrument
         distributed_heartbeat_timeout_s=100.0,
+        trace=bool(trace_dump_raw),
     )
+    if trace_dump_raw:
+        # The embedded master's own spans (rpc.server, lease lifecycle)
+        # join the dump; workers enable via the config env bus.
+        from elasticdl_tpu.common import trace as _trace
+
+        _trace.configure(enabled=True)
     env_base = dict(os.environ)
     env_base.update(config.to_env())
     if platform == "chip":
@@ -315,6 +328,15 @@ def _run_ingest_fleet(
                     p.kill()
             elif p.poll() is None:
                 p.kill()
+        if finished and trace_dump_raw:
+            # After the workers exited (their job-end trace tails shipped
+            # on the final heartbeats) and before the server goes away.
+            try:
+                with open(trace_dump_raw, "w") as f:
+                    json.dump(servicer.DumpTrace({}), f)
+                log(f"raw trace dump -> {trace_dump_raw}")
+            except Exception as e:  # a failed dump must not fail the bench
+                log(f"trace dump failed: {e}")
         server.stop()
     if not finished:
         raise RuntimeError(

@@ -41,7 +41,11 @@ from elasticdl_tpu.ps.service import PSServer, RemoteEmbeddingStore
 def _lat_stats(prefix: str, samples_s: list) -> dict:
     from tools.artifact import latency_stats
 
-    return latency_stats([s * 1e3 for s in samples_s], prefix=f"{prefix}_")
+    # buckets=True: the shared histogram grid (tools/artifact.py) so the
+    # artifact carries the tail SHAPE, not just p50/p99 points.
+    return latency_stats(
+        [s * 1e3 for s in samples_s], prefix=f"{prefix}_", buckets=True
+    )
 
 
 def bench_fleet(n_shards: int, rows: int, dim: int, iters: int) -> dict:

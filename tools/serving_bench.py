@@ -132,7 +132,9 @@ def _drive_point(
         "achieved_qps": round(len(done) / wall, 1),
         "n": len(done),
         "errors": total - len(done),
-        **latency_stats(done),
+        # buckets=True: the shared histogram grid (tools/artifact.py) so
+        # the artifact carries the tail SHAPE, not just p50/p99 points.
+        **latency_stats(done, buckets=True),
     }
     if errors:
         out["error_samples"] = errors[:5]

@@ -1,0 +1,158 @@
+"""trace_dump — merge a live job's per-process trace buffers into ONE
+Chrome-trace/Perfetto JSON.
+
+The recording half is ``common/trace.py`` (per-process ring buffers;
+workers ship bounded slices to the master on the heartbeat/report channel);
+this tool is the reading half: call the master's ``DumpTrace`` RPC, align
+every process's clock onto the master's via the worker-measured RTT-
+midpoint offsets, and write a file ``chrome://tracing`` / ui.perfetto.dev
+loads directly — one row of process tracks per worker plus the master,
+with phase spans, RPC client/server pairs, gang-boundary waits, lease
+lifecycle instants and elastic transitions on a single timeline.
+
+Clock alignment: each worker estimates ``offset = master_clock -
+worker_clock`` as ``server_ts - (t0 + t1) / 2`` around its Heartbeat RPC
+(the server stamps its clock mid-call; the midpoint assumption's error is
+bounded by RTT asymmetry) and ships the estimate with its slices.  Merging
+ADDS the offset to that process's timestamps, so every track reads in
+master time.  A process that never measured an offset (e.g. a dump taken
+before its second heartbeat) merges unshifted with a loud note.
+
+Usage:
+    python tools/trace_dump.py --master HOST:PORT [--out trace.json]
+    python tools/trace_dump.py --input dump.json  [--out trace.json]
+        (--input: a saved raw DumpTrace response — offline re-merge)
+    add --raw PATH to also save the unmerged DumpTrace response
+
+jax-free by design: dumping a live job must never pay a backend init.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def fetch_dump(address: str, timeout_s: float = 30.0) -> dict:
+    """One DumpTrace RPC against a running master."""
+    from elasticdl_tpu.common.rpc import JsonRpcClient
+
+    client = JsonRpcClient(address)
+    try:
+        client.wait_ready(timeout_s)
+        return client.call("DumpTrace", {}, timeout_s=timeout_s)
+    finally:
+        client.close()
+
+
+def merge(dump: dict) -> dict:
+    """DumpTrace response -> Chrome trace object (the ``traceEvents``
+    array format both chrome://tracing and Perfetto load).
+
+    Process ids are small ints with ``process_name`` metadata naming the
+    worker (Chrome's legacy viewer insists on integer pids); the master is
+    always pid 0 — its clock is the reference every offset aims at.
+    """
+    events: List[dict] = []
+    notes: List[str] = []
+
+    def emit(src_events, pid: int, name: str, offset_us: float) -> None:
+        events.append({
+            "ph": "M", "pid": pid, "tid": 0, "ts": 0,
+            "name": "process_name", "args": {"name": name},
+        })
+        for e in src_events or ():
+            # A malformed shipped event must not kill the merge — and the
+            # guard must cover the VALUE, not just the key (ts=null from a
+            # truncated/hand-edited raw dump would otherwise raise in the
+            # very arithmetic this skip protects).
+            if not isinstance(e, dict) or isinstance(e.get("ts"), bool) or \
+                    not isinstance(e.get("ts"), (int, float)):
+                continue
+            ev = dict(e)
+            ev["ts"] = float(ev["ts"]) + offset_us
+            ev["pid"] = pid
+            ev.setdefault("tid", 0)
+            events.append(ev)
+
+    emit(dump.get("master_events"), 0, "master", 0.0)
+    processes = dump.get("processes") or {}
+    for pid, wid in enumerate(sorted(processes), start=1):
+        p = processes[wid] or {}
+        offset = p.get("clock_offset_us")
+        if offset is None:
+            notes.append(
+                f"process {wid!r} shipped no clock offset; merged unshifted"
+            )
+            offset = 0.0
+        if p.get("dropped"):
+            notes.append(
+                f"process {wid!r} overwrote ~{p['dropped']} oldest events "
+                "(bounded ring) — its track starts later than the others"
+            )
+        emit(p.get("events"), pid, wid, float(offset))
+
+    out = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "tool": "elasticdl_tpu tools/trace_dump.py",
+            "clock": "master-aligned wall microseconds (RTT-midpoint offsets)",
+        },
+    }
+    if notes:
+        out["otherData"]["notes"] = notes
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="trace_dump", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--master", default="", help="master HOST:PORT to dump")
+    ap.add_argument(
+        "--input", default="",
+        help="saved raw DumpTrace response JSON (offline re-merge)",
+    )
+    ap.add_argument("--out", default="trace.json", help="merged trace path")
+    ap.add_argument(
+        "--raw", default="", help="also save the raw DumpTrace response here"
+    )
+    args = ap.parse_args(argv)
+    if bool(args.master) == bool(args.input):
+        print("trace_dump: exactly one of --master/--input", file=sys.stderr)
+        return 2
+
+    if args.master:
+        dump = fetch_dump(args.master)
+    else:
+        with open(args.input) as f:
+            dump = json.load(f)
+    if args.raw:
+        with open(args.raw, "w") as f:
+            json.dump(dump, f)
+    merged = merge(dump)
+    with open(args.out, "w") as f:
+        json.dump(merged, f)
+    n_proc = 1 + len(dump.get("processes") or {})
+    print(
+        f"trace_dump: {len(merged['traceEvents'])} events across {n_proc} "
+        f"process(es) -> {args.out} (load in chrome://tracing or "
+        "ui.perfetto.dev)",
+        file=sys.stderr,
+    )
+    for note in merged["otherData"].get("notes", ()):
+        print(f"trace_dump: note: {note}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
