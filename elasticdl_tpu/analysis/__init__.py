@@ -21,6 +21,11 @@ gated, not reviewed, into compliance:
 - ``trace-discipline``  ``# hot-path`` functions emit trace events only via
                         the non-blocking ring API (``common/trace.py``
                         span/instant); export/drain calls are findings
+- ``chaos-discipline``  ``# hot-path`` functions cross fault-injection
+                        points only via the no-op-when-disabled
+                        ``chaos.hook`` API (``chaos/inject.py``);
+                        fire/configure/set_context/parse_plan and direct
+                        ChaosInjector construction are findings
 
 v2 adds the interprocedural layer (``analysis/callgraph.py``: resolved
 self-method and module-function call edges across the repo):
@@ -52,6 +57,7 @@ linter must never pay (or hang on) a jax import.
 """
 
 from elasticdl_tpu.analysis.blocking import BlockingPropagationPass
+from elasticdl_tpu.analysis.chaos_discipline import ChaosDisciplinePass
 from elasticdl_tpu.analysis.compat_shim import CompatShimPass
 from elasticdl_tpu.analysis.core import (  # noqa: F401
     Finding,
@@ -84,4 +90,5 @@ def all_passes() -> list:
         ImportHygienePass(),
         LockOrderPass(),
         TraceDisciplinePass(),
+        ChaosDisciplinePass(),
     ]

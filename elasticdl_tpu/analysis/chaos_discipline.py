@@ -1,0 +1,106 @@
+"""chaos-discipline: hot-path fault injection uses the no-op hook only.
+
+``chaos/inject.py`` splits its surface the way ``common/trace.py`` does
+(trace-discipline is the template):
+
+- ``chaos.hook(point, **ctx)`` is the ONE hot-path-legal entry point —
+  disabled (the default), it is a single attribute check and a return, so
+  an unarmed production job pays nothing at the hook crossings;
+- everything else — ``fire`` (the match/act machinery), ``configure`` /
+  ``set_context`` (plan/context mutation under a lock), ``parse_plan``
+  and ``ChaosInjector(...)`` construction — is setup/armed-mode API that
+  belongs at process boundaries (worker __init__, membership apply, main
+  entry points), never inside a ``# hot-path`` function's steady state.
+
+A hot-path call site reaching past ``hook`` would make the INJECTION
+FRAMEWORK a perturbation of its own even with no fault armed — the exact
+failure mode the one-attribute-check design exists to rule out.  This
+pass keeps the split enforced.
+
+Scope notes, mirroring ``trace-discipline``:
+
+- ``except`` handler bodies and nested ``def``/``lambda`` bodies are
+  exempt (error paths and deferred execution own their own time);
+- the non-hook names are matched on chaos-shaped receivers only
+  (``chaos``/``inj``/``injector``/``_INJ``), so an unrelated object's
+  ``configure()`` is never punished; ``ChaosInjector`` construction is
+  matched by name anywhere.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from elasticdl_tpu.analysis.core import Finding, LintPass, SourceFile, attr_chain
+
+#: Non-hook chaos API: flagged in a hot-path body when the receiver looks
+#: like the chaos module/injector.
+_SETUP_ATTRS = {"fire", "configure", "set_context", "parse_plan", "stats"}
+
+_CHAOS_RECEIVER_HINTS = ("chaos", "inj", "injector", "_INJ")
+
+
+def _is_chaos_setup_call(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Name):
+        # Direct construction inside a hot path: the injector is a
+        # process-global built once, never per-call.
+        return f.id == "ChaosInjector"
+    if not isinstance(f, ast.Attribute):
+        return False
+    if f.attr not in _SETUP_ATTRS:
+        return False
+    chain = attr_chain(f)
+    if chain:
+        recv = chain.rsplit(".", 1)[0].split(".")[-1]
+        return recv in _CHAOS_RECEIVER_HINTS
+    # Dynamic receiver (``chaos.default().fire(...)``): the inner call's
+    # own chain carries the hint.
+    inner = f.value
+    if isinstance(inner, ast.Call):
+        ichain = attr_chain(inner.func)
+        return any(
+            part in _CHAOS_RECEIVER_HINTS for part in ichain.split(".")
+        )
+    return False
+
+
+class ChaosDisciplinePass(LintPass):
+    name = "chaos-discipline"
+    description = (
+        "functions marked '# hot-path' may cross fault-injection points "
+        "only through the no-op-when-disabled chaos.hook API; plan/"
+        "context mutation and direct injector use (fire/configure/"
+        "set_context/parse_plan/ChaosInjector) are findings"
+    )
+
+    def run(self, src: SourceFile) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if src.is_hot_path(node.lineno):
+                    self._walk(src, node.body, findings)
+        return findings
+
+    def _walk(self, src, body, findings) -> None:
+        for node in body:
+            self._visit(src, node, findings)
+
+    def _visit(self, src, node, findings) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return  # deferred execution: not this function's hot path
+        if isinstance(node, ast.Try):
+            for stmt in node.body + node.orelse + node.finalbody:
+                self._visit(src, stmt, findings)
+            return  # handlers (error path) skipped
+        if isinstance(node, ast.Call) and _is_chaos_setup_call(node):
+            findings.append(Finding(
+                self.name, src.path, node.lineno,
+                "chaos setup/injector API inside a '# hot-path' function — "
+                "hot-path call sites use the no-op-when-disabled "
+                "chaos.hook(...) only; arm plans at process boundaries, "
+                "or waive with a reason",
+            ))
+        for child in ast.iter_child_nodes(node):
+            self._visit(src, child, findings)

@@ -38,6 +38,9 @@ KNOWN_RULES = {
     # r12: hot-path trace emission must use the non-blocking ring API only
     # (common/trace.py's span/instant); export/drain calls are findings.
     "trace-discipline",
+    # r13: hot-path fault-injection crossings use the no-op-when-disabled
+    # chaos.hook only (chaos/inject.py); setup/injector API is a finding.
+    "chaos-discipline",
     # v2 interprocedural passes (analysis/callgraph.py layer).
     "blocking-propagation",
     "lock-order",

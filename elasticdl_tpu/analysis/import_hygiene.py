@@ -30,6 +30,10 @@ DEFAULT_JAX_FREE_ROOTS = (
     "elasticdl_tpu.common.log_utils",
     "elasticdl_tpu.common.metrics",
     "elasticdl_tpu.common.rpc",
+    # r13: the fault injector rides in the master control plane (rpc.py
+    # imports it) and in the jax-free bench tools — its own root keeps the
+    # contract explicit even if the rpc edge ever moves.
+    "elasticdl_tpu.chaos.inject",
     "elasticdl_tpu.master.main",
     "elasticdl_tpu.master.servicer",
     "elasticdl_tpu.master.pod_manager",

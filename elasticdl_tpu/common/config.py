@@ -232,6 +232,32 @@ class JobConfig:
     # whole recovery warm use 2.  Each spare holds one idle interpreter.
     standby_pool: int = 1
 
+    # --- tail tolerance / fault injection (r13, chaos/inject.py) ---
+    # graftchaos plan: scheduled faults (kill rank-k at step N, stall a
+    # prep, drop/delay a master RPC, delay a PS pull) delivered through
+    # no-op-when-disabled hook points in the worker, the RPC client and
+    # the PS service — docs/robustness.md documents the plan grammar.
+    # Rides the config bus so worker/PS pods inherit it; the GRAFT_CHAOS
+    # env var arms processes the bus does not reach.  "" = disabled
+    # (bit-exact no-op: one attribute check per hook crossing).
+    chaos: str = ""
+    # Deadline-bounded gang boundary (master-side, lockstep mode only):
+    # when a rank lags the gang's newest lockstep seq by more than this
+    # many milliseconds, the master SKIPS the straggler — its in-flight
+    # gang tasks requeue with bounded skip accounting (gang_skip_budget)
+    # and the rank is evicted so the gang re-forms without waiting out
+    # the full task/heartbeat timeouts (OptiReduce's timeout-bounded
+    # collective, done at the boundary this architecture owns).  The
+    # evicted rank restarts and rejoins the next reform; nothing is
+    # trained twice or lost (dispatcher skip accounting, proven by
+    # test).  0 = disabled (the pre-r13 wait-forever boundary).
+    gang_deadline_ms: float = 0.0
+    # How many times one task may be deadline-skipped before a further
+    # skip is charged like a FAILURE (retry budget -> poison-abandon): a
+    # shard that deterministically stalls a rank must not ping-pong the
+    # gang through skip-reform cycles forever.
+    gang_skip_budget: int = 2
+
     # --- optimizer state layout (parallel/trainer.py) ---
     # ZeRO-style cross-replica sharding of the optimizer update: every
     # param-shaped optimizer-state leaf for a REPLICATED (dense) param is
@@ -307,6 +333,17 @@ class JobConfig:
             raise ValueError("--optimizer_sharding_auto_mb must be positive")
         if self.trace_buffer_events < 1:
             raise ValueError("--trace_buffer_events must be >= 1")
+        if self.chaos:
+            # Parse-validate HERE (jax-free, stdlib): a typo'd fault plan
+            # must fail the job submission, not silently never fire and
+            # let a chaos run report tolerance it never exercised.
+            from elasticdl_tpu.chaos.inject import parse_plan
+
+            parse_plan(self.chaos)
+        if self.gang_deadline_ms < 0:
+            raise ValueError("--gang_deadline_ms cannot be negative")
+        if self.gang_skip_budget < 0:
+            raise ValueError("--gang_skip_budget cannot be negative")
         # Kept in sync with ops.embedding.LOOKUP_IMPLS (asserted by tests);
         # not imported from there so this module stays jax-free (the master
         # control plane and pod manager must run without jax).

@@ -16,6 +16,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import grpc
 
+from elasticdl_tpu import chaos
 from elasticdl_tpu.common import trace
 
 SERVICE_NAME = "elasticdl.Master"
@@ -115,7 +116,15 @@ MASTER_SCHEMAS: Dict[str, MessageSchema] = {
         # reports (rank-0-gated), so their phase snapshot rides the
         # heartbeat — without it the master's per-worker decomposition
         # only ever held rank 0 and a straggler rank was invisible.
-        optional={"version": _INT, "phase_times": _DICT},
+        # gang_seq (r13): the rank's lockstep ARRIVAL progress (entries
+        # whose device dispatch it has begun), the deadline-bounded gang
+        # boundary's per-rank signal.  Consumption counters (boundary
+        # ask seq) cannot carry it: prep-ahead and lease batching freeze
+        # every rank's consumption at the same value when the gang
+        # wedges, so only begun-dispatch — riding the background beat,
+        # the one RPC a wedged gang still sends — tells the straggler
+        # from the ranks blocked in the collective on it.
+        optional={"version": _INT, "phase_times": _DICT, "gang_seq": _INT},
     ),
     "GetMembership": MessageSchema(),
     "GetCheckpoint": MessageSchema(),
@@ -331,6 +340,12 @@ class JsonRpcClient:
                 envelope["ctx"] = [sp.span_id]
                 request = dict(request)
                 request["trace"] = envelope
+            # graftchaos hook (no-op when disabled): an armed delay_rpc
+            # sleeps HERE — inside the client span, so the injected
+            # latency shows in the trace exactly where real network
+            # latency would — and a drop_rpc raises ChaosRpcDropped, which
+            # the call site sees as a failed RPC (lossy-network shape).
+            chaos.hook("rpc:client", method=method)
             return self._stubs[method](request, timeout=timeout_s)
 
     def close(self) -> None:

@@ -128,6 +128,7 @@ class Master:
             num_epochs=config.num_epochs if config.job_type == "training" else 1,
             task_type=task_type,
             task_timeout_s=config.task_timeout_s,
+            task_skip_budget=config.gang_skip_budget,
             resume=resume,
         )
         self.evaluation: Optional[EvaluationService] = None
@@ -160,6 +161,8 @@ class Master:
             # --evaluation_steps=0 means "eval at each epoch end" (the
             # reference's semantics); >0 means interval-based rounds.
             epoch_end_eval=config.evaluation_steps == 0,
+            # Deadline-bounded gang boundary (r13, docs/robustness.md).
+            gang_deadline_ms=config.gang_deadline_ms,
         )
         # Task watermark persists when a model checkpoint is REPORTED — the
         # only moment the (model state, data progress) pair is consistent on
@@ -222,6 +225,10 @@ class Master:
             config,
         )
         self.pod_manager.add_listener(self._on_pod_event)
+        # Warm-standby pool depth rides Heartbeat/JobStatus (r13): a
+        # drained pool must be visible BEFORE the next failure finds it
+        # empty and pays a cold relaunch.
+        self.servicer.set_standby_depth(self.pod_manager.standby_depth)
 
     def _load_progress(self, num_shards: int, num_epochs: int):
         if not self._progress_path or not os.path.exists(self._progress_path):

@@ -37,7 +37,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
-from elasticdl_tpu.common import locksan
+from elasticdl_tpu.common import locksan, trace
 from elasticdl_tpu.common.config import JobConfig
 from elasticdl_tpu.common.log_utils import get_logger
 
@@ -226,15 +226,30 @@ class ProcessPodBackend(PodBackend):
         self._standby = keep
 
     def _adopt_standby(self, name: str, full_env: Dict[str, str]):
-        """Hand a parked spare its identity; None if no matching spare."""
+        """Hand a parked spare its identity; None if no matching spare.
+
+        Only a WARMED spare is adoptable: the standby writes a
+        ``<go_file>.ready`` marker once its imports are paid (worker.main
+        ``_park_as_standby``), and a spare still booting is skipped —
+        adopting it would be a cold boot with extra moving parts, and the
+        whole point of the pool is that the relaunch's wall is
+        restore+compile, not imports.  Back-to-back failures beyond the
+        warmed depth therefore degrade to cold spawns (and the pool
+        refills behind them) — spares stay a latency optimization, never
+        a correctness dependency."""
         import json
 
         sig = self._env_sig(full_env)
         with self._lock:
             self._prune_spares_locked(sig)
-            if not self._standby:
+            chosen = None
+            for i, (proc_i, go_i, _s) in enumerate(self._standby):
+                if os.path.exists(go_i + ".ready"):
+                    chosen = i
+                    break
+            if chosen is None:
                 return None
-            proc, go_file, _ = self._standby.pop(0)
+            proc, go_file, _ = self._standby.pop(chosen)
         # Atomic publish: the standby polls for existence, so the content
         # must be complete the moment the path appears.
         payload = {
@@ -261,10 +276,22 @@ class ProcessPodBackend(PodBackend):
             except OSError:
                 logger.warning("could not link %s -> %s", link, spare_log)
         logger.info("adopted warm standby (pid %d) as %s", proc.pid, name)
+        # Two instants, one moment: the standby lifecycle event and the
+        # splice-timeline stage chaos_bench decomposes recovery over
+        # (detect -> adopt -> reformed, docs/robustness.md).
+        trace.instant("standby:adopt", cat="standby", pod=name, pid=proc.pid)
+        trace.instant(
+            "elastic:splice", cat="elastic", stage="adopt",
+            pod=name, pid=proc.pid,
+        )
         return proc
 
-    def _fill_standby_pool(self, full_env: Dict[str, str]) -> None:
-        """Top the pool up to ``standby_pool`` live same-env spares."""
+    def _fill_standby_pool(
+        self, full_env: Dict[str, str], reason: str = "spawn"
+    ) -> None:
+        """Top the pool up to ``standby_pool`` live same-env spares.
+        ``reason`` tags the lifecycle instant: ``spawn`` for the initial
+        fill, ``refill`` when replacing an adopted spare."""
         import tempfile
 
         sig = self._env_sig(full_env)
@@ -315,12 +342,17 @@ class ProcessPodBackend(PodBackend):
                     self._reap(proc)
                     return
                 self._standby.append((proc, go_file, sig))
+                depth = len(self._standby)
             logger.info("warm standby parked (pid %d)", proc.pid)
+            trace.instant(
+                f"standby:{reason}", cat="standby", pid=proc.pid, depth=depth
+            )
 
     def start_pod(self, name: str, env: Dict[str, str]) -> None:
         full_env = dict(os.environ) if self._inherit else {}
         full_env.update(env)
         proc = self._adopt_standby(name, full_env) if self._warm else None
+        adopted = proc is not None
         if proc is None:
             log = self._pod_stdio(name)
             try:
@@ -332,7 +364,9 @@ class ProcessPodBackend(PodBackend):
                 if log is not None:
                     log.close()
         if self._warm:
-            self._fill_standby_pool(full_env)
+            self._fill_standby_pool(
+                full_env, reason="refill" if adopted else "spawn"
+            )
         with self._lock:
             self._procs[name] = proc
             if self._watcher is None:
@@ -379,6 +413,10 @@ class ProcessPodBackend(PodBackend):
                         phase = PodPhase.RESTART
                     else:
                         phase = PodPhase.FAILED
+                    # The exit code is the only forensic a silently-dying
+                    # pod leaves (negative = killed by that signal); the
+                    # chaos work made clear the watcher must say it.
+                    logger.info("pod %s exited rc=%s -> %s", name, rc, phase)
                     self._emit(name, phase)
             except Exception:
                 # The watcher is the only observer of worker exits; it must
@@ -390,6 +428,15 @@ class ProcessPodBackend(PodBackend):
         with self._lock:
             proc = self._procs.get(name)
             return proc.pid if proc is not None else None
+
+    def standby_depth(self) -> Optional[int]:
+        """Live parked spares right now (the Heartbeat/JobStatus gauge);
+        None when warm standby is off — "no pool" and "drained pool" must
+        not read the same."""
+        if not self._warm:
+            return None
+        with self._lock:
+            return sum(1 for p, _, _ in self._standby if p.poll() is None)
 
     def close(self) -> None:
         self._stop.set()
@@ -821,6 +868,17 @@ class PodManager:
             elif phase in (PodPhase.SUCCEEDED, PodPhase.DELETED):
                 if self._slots.get(info.slot) is info:
                     self._slots[info.slot] = None
+        if phase == PodPhase.FAILED:
+            # The splice timeline's t0: the master KNOWS the pod is gone.
+            # chaos_bench decomposes recovery as detect -> adopt ->
+            # reformed -> trained-again from these master-clock instants
+            # (the dying worker's own chaos:kill instant never ships —
+            # its buffer dies with it).
+            trace.instant(
+                "elastic:splice", cat="elastic", stage="detect", pod=name,
+                slot=info.slot,
+                relaunch=relaunch_info.name if relaunch_info else None,
+            )
         self._notify(name, phase)
         if relaunch_info is not None:
             logger.info(
@@ -850,6 +908,12 @@ class PodManager:
     def pod_info(self, name: str) -> Optional[PodInfo]:
         with self._lock:
             return self._by_name.get(name)
+
+    def standby_depth(self) -> Optional[int]:
+        """Warm-standby pool depth, or None when the backend has no pool
+        (fake/kubernetes backends, warm standby off)."""
+        fn = getattr(self._backend, "standby_depth", None)
+        return fn() if fn is not None else None
 
     def all_finished(self) -> bool:
         """True when every slot's pod has reached a terminal phase."""

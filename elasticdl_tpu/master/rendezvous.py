@@ -17,7 +17,7 @@ from __future__ import annotations
 import time
 from typing import Callable, Dict, List, Optional
 
-from elasticdl_tpu.common import locksan
+from elasticdl_tpu.common import locksan, trace
 
 
 class RendezvousServer:
@@ -43,6 +43,11 @@ class RendezvousServer:
         self._timeout = heartbeat_timeout_s
         self._clock = clock
         self._listeners: List[Callable[[int, List[str]], None]] = []
+        # Versions whose membership has been FULLY confirmed at least once
+        # (every live member heartbeat/registered at that version) — each
+        # gets one ``elastic:reformed`` instant, the splice timeline's
+        # "the gang is whole again" stage (r13, docs/robustness.md).
+        self._reformed: set = set()  # guarded-by: _lock
         # DESIRED world size (the pod manager's fleet target; 0 = unknown).
         # Workers' multihost settle loop forms the world the moment the
         # full expected gang is registered instead of heuristically waiting
@@ -83,6 +88,7 @@ class RendezvousServer:
             if not changed:
                 if confirmed:
                     self._confirmed[worker_id] = self._version
+                    self._maybe_reformed_locked()
                 return self._version
             self._version += 1
             if confirmed:
@@ -91,6 +97,7 @@ class RendezvousServer:
                 self._confirmed[worker_id] = self._version
             else:
                 self._confirmed.pop(worker_id, None)
+            self._maybe_reformed_locked()
             members = sorted(self._workers)
             version = self._version
         self._notify(version, members)
@@ -119,12 +126,28 @@ class RendezvousServer:
                 self._workers[worker_id] = self._clock()
                 if version is not None:
                     self._confirmed[worker_id] = int(version)
+                    self._maybe_reformed_locked()
                 return self._version
         # Revival of an evicted worker: alive, but its address was dropped at
         # eviction and it has not applied the post-revival membership — so it
         # must NOT count as confirmed (the returned version differs from the
         # worker's own, which makes it re-read membership / restart).
         return self.register(worker_id, confirmed=False)
+
+    def _maybe_reformed_locked(self) -> None:  # guarded-by: _lock
+        """One ``elastic:reformed`` instant per version, the moment EVERY
+        live member has confirmed it — the splice timeline's end of the
+        membership transition (trace.instant is a lock-free ring append,
+        so emitting under this leaf lock acquires nothing)."""
+        v = self._version
+        if v in self._reformed or not self._workers:
+            return
+        if all(self._confirmed.get(w) == v for w in self._workers):
+            self._reformed.add(v)
+            trace.instant(
+                "elastic:reformed", cat="elastic", version=v,
+                world=len(self._workers),
+            )
 
     def all_confirmed(self, version: int) -> bool:
         """True iff ``version`` is current and every live member has

@@ -36,6 +36,7 @@ pull that tools/trace_dump.py merges into one Chrome trace).
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from concurrent import futures
 from typing import Dict, Optional
@@ -72,6 +73,8 @@ class MasterServicer:
         metrics_writer=None,
         max_steps: int = 0,
         epoch_end_eval: bool = False,
+        gang_deadline_ms: float = 0.0,
+        clock=time.monotonic,
     ):
         self.dispatcher = dispatcher
         self.rendezvous = rendezvous or RendezvousServer()
@@ -139,6 +142,25 @@ class MasterServicer:
         self._group_lock = locksan.lock("MasterServicer._group_lock", before=("_lock",))  # lock-order: before(_lock)
         self._group_version: Optional[int] = None  # guarded-by: _group_lock
         self._group_log: list = []  # guarded-by: _group_lock
+        # Deadline-bounded gang boundary (r13, --gang_deadline_ms): per-rank
+        # lockstep ARRIVAL progress.  Heartbeats carry each rank's
+        # ``gang_seq`` (entries whose dispatch it has BEGUN — see
+        # _note_gang_progress_locked for why arrival, not consumption);
+        # the head is the newest arrival any rank has shown plus the time
+        # the gang's FRONT reached it.  A rank lagging the head past the
+        # deadline is the straggler: its in-flight gang tasks requeue
+        # through the dispatcher's skip accounting and the rank is
+        # evicted so the gang re-forms without waiting out the full
+        # task/heartbeat timeouts.  0 disables (pre-r13 behavior).
+        self._gang_deadline_s = max(0.0, gang_deadline_ms) / 1e3
+        self._clock = clock
+        self._gang_arrivals: Dict[str, tuple] = {}  # guarded-by: _group_lock
+        self._gang_head: tuple = (0, None)  # (seq, first-ask t)  guarded-by: _group_lock
+        self._skipped_ranks: Dict[str, int] = {}  # guarded-by: _lock
+        # Warm-standby pool introspection (r13): master main wires the
+        # PodManager's depth here; Heartbeat/JobStatus republish it so a
+        # DRAINED pool is visible before the next failure needs it.
+        self._standby_depth_fn = None  # guarded-by: _lock
 
     # -- rendezvous listener: requeue tasks of evicted workers --
 
@@ -170,6 +192,11 @@ class MasterServicer:
         with self._group_lock:
             gv, self._group_version = self._group_version, None
             self._group_log = []
+            # Gang-boundary progress is per-world: a new membership gets a
+            # fresh deadline clock (stale arrivals from the old world must
+            # not instantly "skip" a member of the new one).
+            self._gang_arrivals = {}
+            self._gang_head = (0, None)
         if gv is not None and gv != version:
             lost = self.dispatcher.recover_tasks(self.group_worker_id(gv))
             if self.evaluation is not None:
@@ -247,6 +274,10 @@ class MasterServicer:
         seq = int(req["seq"])
         version = int(req["version"])
         lease = max(1, int(req.get("lease", 1)))
+        # The boundary polices its own deadline: every crossing checks for
+        # a rank lagging the gang head (Heartbeat covers the wedged-gang
+        # case where no rank polls the boundary at all).
+        self.maybe_skip_straggler()
         stale = {"task": None, "finished": False, "stale": True}
         if version != self.rendezvous.version():
             return stale
@@ -262,6 +293,8 @@ class MasterServicer:
                         self.evaluation.recover_tasks(old)
                 self._group_version = version
                 self._group_log = []
+                self._gang_arrivals = {}
+                self._gang_head = (0, None)
             if seq > len(self._group_log):
                 # A process can only be at most one entry ahead of the log;
                 # anything else is a protocol bug or a stale world — restart.
@@ -309,6 +342,98 @@ class MasterServicer:
             return dict(
                 entries[0], stale=False, entries=[dict(e) for e in entries]
             )
+
+    def _note_gang_progress_locked(self, worker_id: str, seq: int) -> None:  # guarded-by: _group_lock
+        """Monotonic per-rank lockstep ARRIVAL progress, fed exclusively
+        from the heartbeat's ``gang_seq`` — the count of group entries
+        whose device dispatch the rank has BEGUN (Worker._gang_dispatched).
+        That counter is the one signal that separates the straggler from
+        its victims once the gang wedges: the ranks blocked INSIDE the
+        collective have counted the entry (they arrived, then blocked)
+        while the rank that never reached the boundary has not — and it
+        rides the background liveness beat, which keeps flowing when
+        every task loop in the gang is blocked.  Consumption signals
+        (boundary ask seq, popped-entry counts) are deliberately NOT fed
+        here: lease batching and prep-ahead freeze every rank's
+        consumption at the same value the moment the gang wedges, which
+        would mask the lag this deadline exists to see."""
+        now = self._clock()
+        prev = self._gang_arrivals.get(worker_id)
+        if prev is None or seq > prev[0]:
+            self._gang_arrivals[worker_id] = (seq, now)
+        if seq > self._gang_head[0] or self._gang_head[1] is None:
+            self._gang_head = (seq, now)
+
+    def note_gang_progress(self, worker_id: str, seq: int, version) -> None:
+        """Heartbeat-side progress feed (see _note_gang_progress_locked);
+        version-gated so a beat from a stale world cannot seed the new
+        world's deadline clock."""
+        with self._group_lock:
+            if self._group_version is None or version != self._group_version:
+                return
+            self._note_gang_progress_locked(worker_id, seq)
+
+    # hot-path: rides every Heartbeat and GetGroupTask — the steady state
+    # is a bounded dict scan under the group lock; the eviction branch
+    # fires at most once per deadline window
+    def maybe_skip_straggler(self) -> Optional[str]:
+        """Deadline-bounded gang boundary (r13): when a rank lags the
+        gang's newest lockstep seq past ``gang_deadline_ms``, SKIP it —
+        requeue the gang's in-flight tasks through the dispatcher's
+        bounded skip accounting, then evict the rank so the membership
+        bump re-forms the gang without it (the straggler's own restart
+        path re-joins it at the next reform).  Driven from Heartbeat as
+        well as GetGroupTask because a wedged gang stops polling the
+        boundary: the fast ranks are blocked inside the collective on the
+        straggler, and only the background heartbeat threads still reach
+        the master.  Returns the skipped worker id, or None."""
+        if not self._gang_deadline_s:
+            return None
+        with self._group_lock:
+            version = self._group_version
+            head_seq, head_t = self._gang_head
+            if version is None or head_t is None:
+                return None
+            now = self._clock()
+            if now - head_t < self._gang_deadline_s:
+                return None
+            behind = [
+                (s, w) for w, (s, _) in self._gang_arrivals.items()
+                if s < head_seq
+            ]
+            if not behind:
+                return None
+            behind.sort()
+            seq_behind, straggler = behind[0]
+            # One eviction per deadline window: the clock restarts so a
+            # second laggard gets its own full deadline against the
+            # (re-formed) gang rather than being batch-evicted with the
+            # first — skips must stay attributable one rank at a time.
+            self._gang_head = (head_seq, now)
+            self._gang_arrivals.pop(straggler, None)
+        trace.instant(
+            "gang:skip", cat="gang", worker=straggler, seq=seq_behind,
+            head_seq=head_seq, version=version,
+            deadline_ms=self._gang_deadline_s * 1e3,
+        )
+        with self._lock:
+            self._skipped_ranks[straggler] = (
+                self._skipped_ranks.get(straggler, 0) + 1
+            )
+        # Skip-accounted requeue BEFORE the membership bump: the generic
+        # invalidation path (_on_membership_change) would requeue the same
+        # tasks without charging the skip budget, and unbounded free skips
+        # are exactly what lets a poison shard wedge the gang forever.
+        skipped = self.dispatcher.skip_tasks(self.group_worker_id(version))
+        logger.warning(
+            "gang deadline: rank %s lags boundary seq %d (gang head %d) "
+            "past %.0f ms — skipping it (%d in-flight gang task(s) "
+            "requeued with skip accounting)",
+            straggler, seq_behind, head_seq, self._gang_deadline_s * 1e3,
+            len(skipped),
+        )
+        self.rendezvous.remove(straggler)
+        return straggler
 
     def _under_drain_or_eval_pressure(self) -> bool:
         """True when new lockstep-log entries should not be materialized
@@ -601,6 +726,17 @@ class MasterServicer:
         self._record_phase_times(req, stream=False)
         # Trace slices ride the heartbeat (the pull path's supply side).
         self._record_trace(req)
+        # Gang-deadline watchdog (r13): heartbeats are the only RPCs still
+        # arriving when the whole gang is wedged in a collective on a
+        # straggler — the beat both FEEDS the per-rank progress signal
+        # (gang_seq, the dispatch counter boundary asks cannot carry) and
+        # drives the skip decision on it.
+        gang_seq = req.get("gang_seq")
+        if gang_seq is not None and self._gang_deadline_s:
+            self.note_gang_progress(
+                req["worker_id"], int(gang_seq), req.get("version")
+            )
+        self.maybe_skip_straggler()
         resp = {
             "version": self.rendezvous.heartbeat(
                 req["worker_id"], req.get("version")
@@ -617,6 +753,15 @@ class MasterServicer:
         # their buffer (immediate requeue) and pull the eval work instead.
         if self.evaluation is not None and self.evaluation.tasks_pending():
             resp["eval_pending"] = True
+        # Standby-pool depth (r13): riding the beat keeps a DRAINED warm
+        # pool visible to operators/benches before the next failure needs
+        # a spare (the fn reads one leaf lock; None = no pool wired).
+        with self._lock:
+            depth_fn = self._standby_depth_fn
+        if depth_fn is not None:
+            depth = depth_fn()
+            if depth is not None:
+                resp["standby_pool"] = int(depth)
         # Drain hint (r9): past --max_steps the dispatcher stops, but it
         # cannot recall leases a worker already buffers — without the hint
         # the worker would train up to lease_batch-1 tasks beyond the
@@ -654,6 +799,13 @@ class MasterServicer:
         with self._lock:
             self._on_checkpoint = fn
 
+    def set_standby_depth(self, fn) -> None:
+        """Wire a callable returning the warm-standby pool depth (master
+        main passes PodManager.standby_depth); Heartbeat/JobStatus
+        republish it."""
+        with self._lock:
+            self._standby_depth_fn = fn
+
     def JobStatus(self, req: dict) -> dict:
         status = self.dispatcher.counts()
         with self._lock:
@@ -664,6 +816,14 @@ class MasterServicer:
             status["phase_counts"] = {
                 w: dict(c) for w, c in self._phase_counts.items()
             }
+            # r13 tail tolerance: per-rank deadline-skip counts, beside
+            # the dispatcher's per-task accounting already in ``status``.
+            status["skipped_ranks"] = dict(self._skipped_ranks)
+            depth_fn = self._standby_depth_fn
+        if depth_fn is not None:
+            depth = depth_fn()
+            if depth is not None:
+                status["standby_pool"] = int(depth)
         if self.evaluation is not None:
             status["eval_metrics"] = self.evaluation.latest_metrics()
             status["eval_rounds"] = self.evaluation.completed_rounds()

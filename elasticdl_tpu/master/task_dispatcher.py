@@ -68,6 +68,7 @@ class TaskDispatcher:
         task_type: str = TASK_TRAINING,
         task_timeout_s: float = 600.0,
         max_task_retries: int = 3,
+        task_skip_budget: int = 2,
         clock: Callable[[], float] = time.monotonic,
         resume: Optional[dict] = None,
     ):
@@ -78,6 +79,7 @@ class TaskDispatcher:
         self._task_type = task_type
         self._timeout = task_timeout_s
         self._max_retries = max_task_retries
+        self._skip_budget = task_skip_budget
         self._clock = clock
 
         # Callbacks (_fire_epoch_end) and callers' locks stay outside this
@@ -88,6 +90,13 @@ class TaskDispatcher:
         self._done_count = 0
         self._abandoned = 0
         self._failed_counts: Dict[int, int] = {}
+        # Deadline-skip accounting (r13, the gang boundary's safety proof):
+        # per-task skip counts and a counter of late SUCCESS reports
+        # REJECTED — the explicit zero-double-train check the chaos
+        # artifact stamps.
+        self._skip_counts: Dict[int, int] = {}
+        self._skipped_events = 0
+        self._duplicate_done = 0
         self._next_task_id = 0
         self._epoch = -1  # _refill brings it to 0
         self._finished = not self._shards
@@ -264,6 +273,15 @@ class TaskDispatcher:
         with self._lock:
             entry = self._doing.pop(task_id, None)
             if entry is None:
+                if success:
+                    # A late SUCCESS for a task no longer in flight: a
+                    # duplicate of an already-counted result, or — the
+                    # double-train hazard — a task that was requeued
+                    # (timeout/skip raced the report) and whose records
+                    # will train again.  Either way the rejection is
+                    # counted, so the chaos artifact's zero-double-train
+                    # check is an observable number, not an assumption.
+                    self._duplicate_done += 1
                 return False
             if success:
                 self._done_count += 1
@@ -308,6 +326,50 @@ class TaskDispatcher:
             )
         return lost
 
+    def skip_tasks(self, worker_id: str) -> List[Task]:
+        """Deadline-skip requeue (r13, the gang boundary's accounting):
+        requeue every in-flight task of ``worker_id`` — the lockstep
+        group pseudo worker whose gang just skipped a straggler — with
+        BOUNDED skip accounting.  The first ``task_skip_budget`` skips of
+        a task requeue free (elastic churn must not poison a healthy
+        shard, the r9 requeue_only stance); past the budget a skip is
+        charged like a FAILURE, so a shard that deterministically stalls
+        a rank flows into the existing poison-task abandon path instead
+        of ping-ponging the gang through skip-reform cycles forever.
+        Exactly-once is preserved by construction: a skipped task left
+        ``doing`` unreported, so it requeues exactly once here and its
+        eventual success is counted once (the duplicate-done counter
+        proves the claim at run time)."""
+        with self._lock:
+            lost = [
+                d.task for d in self._doing.values()
+                if d.worker_id == worker_id
+            ]
+            for task in lost:
+                del self._doing[task.task_id]
+                self._skipped_events += 1
+                if self._stopped:
+                    continue  # draining: skipped work must not retrain
+                skips = self._skip_counts.get(task.task_id, 0) + 1
+                self._skip_counts[task.task_id] = skips
+                if skips <= self._skip_budget:
+                    self._todo.appendleft(task)
+                    continue
+                fails = self._failed_counts.get(task.task_id, 0) + 1
+                self._failed_counts[task.task_id] = fails
+                if fails <= self._max_retries:
+                    self._todo.appendleft(task)
+                else:
+                    self._abandoned += 1
+            self._refill()
+        for task in lost:
+            trace.instant(
+                "lease:skip", cat="lease", task=task.task_id,
+                worker=worker_id,
+            )
+        self._fire_epoch_end()
+        return lost
+
     def _requeue_timed_out(self) -> None:
         now = self._clock()
         stale = [
@@ -345,5 +407,11 @@ class TaskDispatcher:
                 "done": self._done_count,
                 "abandoned": self._abandoned,
                 "epoch": self._epoch,
+                # r13 tail-tolerance accounting: total deadline-skip events,
+                # per-task skip counts, and the explicit zero-double-train
+                # counter (rejected late SUCCESS reports).
+                "skipped": self._skipped_events,
+                "skip_counts": dict(self._skip_counts),
+                "duplicate_done": self._duplicate_done,
                 "finished": self._finished and not self._todo and not self._doing,
             }

@@ -50,6 +50,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         from elasticdl_tpu.common import trace as _trace
 
         _trace.configure(enabled=True, capacity=config.trace_buffer_events)
+    if config.chaos:
+        # graftchaos rides the same config bus as --trace: delay_ps faults
+        # arm in the shard process itself (GRAFT_CHAOS env works too).
+        from elasticdl_tpu import chaos as _chaos
+
+        _chaos.configure(config.chaos)
 
     slot = int(os.environ.get("ELASTICDL_WORKER_SLOT", "0"))
     ports = [

@@ -58,6 +58,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import grpc
 import numpy as np
 
+from elasticdl_tpu import chaos
 from elasticdl_tpu.common import locksan, trace
 from elasticdl_tpu.common.log_utils import get_logger
 
@@ -326,6 +327,11 @@ class PSServer:
     def _pull(self, meta, arrays):
         store = self._store_for(meta)
         ids = self._require(arrays, "ids", np.int64)
+        # graftchaos: delay_ps faults land here — the server side of the
+        # pull, so the injected latency is indistinguishable from a slow
+        # shard to every consumer (worker host-tier pulls, serving cache
+        # misses).  No-op when disabled (chaos-discipline).
+        chaos.hook("ps:pull", table=meta["table"])
         lock = self._locks[meta["table"]]
         # Span via the non-blocking ring API only (trace-discipline): the
         # PS read is the serving/training tiers' shared tail-latency

@@ -87,6 +87,15 @@ def _park_as_standby(go_file: str) -> str:
     ):
         importlib.import_module(mod)
     logger.info("standby warmed (pid %d); parking on %s", os.getpid(), go_file)
+    # Readiness marker (atomic publish, like the go file itself): only a
+    # WARMED spare is worth adopting — the pod manager skips spares whose
+    # marker is absent and cold-spawns instead (ProcessPodBackend
+    # _adopt_standby), so a burst of failures never queues behind a spare
+    # that is still paying its imports.
+    ready = go_file + ".ready"
+    with open(ready + ".tmp", "w") as f:
+        f.write(str(os.getpid()))
+    os.replace(ready + ".tmp", ready)
     parent0 = os.getppid()
     while not os.path.exists(go_file):
         if os.getppid() != parent0:
@@ -298,12 +307,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         dw_state: dict = {"pending_since": None}
         while not hb_stop.wait(0.25 if dw_state["pending_since"] else 1.0):
             master_version = None
+            w = worker_holder.get("worker")
             try:
-                resp = master.call("Heartbeat", {"worker_id": worker_id})
+                hb = {"worker_id": worker_id}
+                if w is not None:
+                    # Gang-boundary arrival progress (r13): the beat is
+                    # the only RPC still leaving this process while the
+                    # task loop is blocked inside a wedged collective —
+                    # without it the deadline-bounded boundary could
+                    # never tell the straggler (arrival counter frozen)
+                    # from the ranks blocked on it (counter one ahead).
+                    hb.update(w.gang_beat_fields())
+                resp = master.call("Heartbeat", hb)
                 master_version = resp.get("version")
             except Exception:  # master briefly unreachable: retry next beat
                 pass
-            w = worker_holder.get("worker")
             if w is None:
                 continue
             try:

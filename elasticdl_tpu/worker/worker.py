@@ -24,6 +24,7 @@ from typing import Any, Dict, Iterable, List, NamedTuple, Optional, Sequence
 import jax
 import numpy as np
 
+from elasticdl_tpu import chaos
 from elasticdl_tpu.common import locksan, trace
 from elasticdl_tpu.common.checkpoint import CheckpointManager
 from elasticdl_tpu.common.config import JobConfig
@@ -171,6 +172,24 @@ class Worker:
         # rank 0 reports results.
         self._group_mode = False
         self._task_seq = 0
+        # Gang-boundary ARRIVAL counter (r13, the deadline-bounded gang
+        # boundary's per-rank progress signal): group-log entries whose
+        # device dispatch this rank has BEGUN.  Incremented immediately
+        # before the first (blocking, collective-bearing) device call of
+        # each group task, so a rank blocked INSIDE a wedged collective
+        # has counted the entry while the straggler that never arrived at
+        # it has not — consumption counters (_task_seq, boundary ask seq)
+        # cannot make that distinction: lease batching and prep-ahead
+        # freeze every rank's consumption at the same value the moment
+        # the gang wedges.  Read cross-thread by the liveness beat (int
+        # read under the GIL), which is the only RPC still leaving this
+        # process while the task loop is blocked in the collective.
+        # _gang_last_task guards the count against the in-place transient
+        # collective retry (_retry_transient_collective re-dispatches the
+        # SAME entry): a retried rank must not drift ahead of its peers,
+        # or the deadline would read every HEALTHY rank as the laggard.
+        self._gang_dispatched = 0
+        self._gang_last_task = -1
         self._ckpt: Optional[CheckpointManager] = None
         # Checkpoint watermark + background-save thread handle: touched by
         # the task loop, the background save thread (failure rollback), and
@@ -235,6 +254,13 @@ class Worker:
                 enabled=True, capacity=config.trace_buffer_events
             )
         self._trace_clock_offset_us: Optional[float] = None
+        # graftchaos (chaos/inject.py): the --chaos fault plan rides the
+        # config bus exactly like --trace; faults address this process by
+        # worker id or rank (set_context keeps the rank current across
+        # reforms — see _apply_membership).
+        if config.chaos:
+            chaos.configure(config.chaos)
+        chaos.set_context(worker_id=worker_id, rank=self._rank)
 
         if config.checkpoint_dir:
             self._ckpt = CheckpointManager(
@@ -290,6 +316,8 @@ class Worker:
         self._ranks = dict(membership["ranks"])
         self._addresses = dict(membership.get("addresses") or {})
         self._rank = self._ranks.get(self.worker_id, 0)
+        # Rank-addressed chaos faults must follow the rank across reforms.
+        chaos.set_context(rank=self._rank)
         self._group_mode = self.config.multihost and len(self._ranks) > 1
         if self.config.multihost and not initial:
             # The jax.distributed world is fixed per process (PJRT can't be
@@ -371,6 +399,22 @@ class Worker:
         mesh = create_mesh(self._pool, num_devices=n_dev, dcn_parallelism=dcn)
         if initial or self.trainer is None:
             self.trainer = Trainer(self.spec, self.config, mesh)
+        elif (
+            list(self.trainer.mesh.devices.flat) == list(mesh.devices.flat)
+            and self.trainer.mesh.shape == mesh.shape
+        ):
+            # Identical mesh: a non-multihost pool worker sees peers join
+            # and leave without its LOCAL device set ever changing
+            # (n_dev = min(world*dpw, len(pool)) saturates), and
+            # re-sharding state onto the same devices is pure churn — a
+            # dropped dispatch pipeline at best, and on the 1-real-cpu-
+            # device harness an XLA:CPU crash at worst (the chaos bench's
+            # pool fleets segfaulted HERE on every peer churn before this
+            # guard).  Adopt the version; keep the trainer.
+            logger.info(
+                "membership v%d keeps this worker's mesh (%d devices); "
+                "adopting without re-forming", version, mesh.devices.size,
+            )
         else:
             self.reforms += 1
             logger.info(
@@ -511,6 +555,20 @@ class Worker:
         )
         return True
 
+    def gang_beat_fields(self) -> dict:
+        """Fields the background liveness beat (worker.main ``_beat``)
+        adds to its Heartbeat so the deadline-bounded gang boundary keeps
+        seeing per-rank arrival progress while the task loop is blocked
+        inside a wedged collective — the loop's own heartbeat (the other
+        carrier) is silent exactly then.  Plain int/None reads under the
+        GIL; safe from the beat thread."""
+        if not self._group_mode:
+            return {}
+        return {
+            "gang_seq": self._gang_dispatched,
+            "version": self._membership_version,
+        }
+
     def _trace_payload(self) -> Optional[dict]:
         """One bounded slice of this process's trace ring for the
         heartbeat/report channel, with the latest clock-offset estimate —
@@ -533,6 +591,14 @@ class Worker:
         # master's lockstep task log withholds collective tasks until every
         # member confirms the current topology (see RendezvousServer).
         hb = {"worker_id": self.worker_id, "version": self._membership_version}
+        if self._group_mode:
+            # Gang-boundary arrival for the deadline-bounded boundary
+            # (r13): entries whose dispatch this rank has BEGUN (see
+            # _gang_dispatched in __init__).  Also carried by the
+            # background liveness beat (gang_beat_fields) — this loop
+            # heartbeat stops the moment the loop blocks inside a wedged
+            # collective, which is exactly when the signal matters.
+            hb["gang_seq"] = self._gang_dispatched
         if self._group_mode and self._rank != 0:
             # Non-rank-0 members never send task reports (rank-0-gated in
             # _flush), so the heartbeat carries their phase snapshot —
@@ -942,6 +1008,11 @@ class Worker:
         semantics are bit-identical to the serial path (the feed decodes
         each record independently, so a chunked feed concatenates to
         exactly the serial feed's bytes)."""
+        # graftchaos: stall(point=prep) — the host-side straggler the
+        # deadline-bounded gang boundary exists to cut short.
+        chaos.hook(
+            "worker:prep", rank=self._rank, step=self._steps_dispatched
+        )
         mb = self.config.minibatch_size
         shard = task.shard
         pool = self._ingest
@@ -1021,6 +1092,18 @@ class Worker:
         fused branch (``n_full >= 1``) or is a pure-tail task whose records
         are exactly ``prep.tail``.
         """
+        if self._group_mode and task.task_id != self._gang_last_task:
+            # Gang-boundary arrival (r13): this entry's dispatch BEGINS
+            # now — counted before the first device call so a rank that
+            # blocks inside the collective below has still arrived at it,
+            # and at most once per entry so the in-place collective retry
+            # cannot inflate it (see _gang_dispatched in __init__).
+            self._gang_last_task = task.task_id
+            self._gang_dispatched += 1
+        # graftchaos: stall(point=step) — a device-dispatch-side straggler.
+        chaos.hook(
+            "worker:step", rank=self._rank, step=self._steps_dispatched
+        )
         mb = self.config.minibatch_size
         if prep is not None:
             records = None
@@ -1834,6 +1917,20 @@ class Worker:
                 time.sleep(self._poll)
                 continue
             task = Task.from_dict(resp["task"])
+            # graftchaos: kill / stall(point=task) faults fire at the task
+            # boundary — after the lease, before any device work, so a
+            # killed rank's task requeues through the ordinary loss path.
+            # BEFORE the seq increment: a rank wedged in this hook has not
+            # begun the entry, and its lockstep progress mirror (gang_seq,
+            # the deadline-bounded boundary's per-rank signal) must not
+            # count it — on a harness without dispatch lookahead the
+            # healthy peers sit at the SAME consumed seq, and an
+            # already-incremented straggler would be indistinguishable
+            # from them, invisible to the very deadline built to cut it.
+            chaos.hook(
+                "worker:task", rank=self._rank,
+                step=self._steps_dispatched, task_id=task.task_id,
+            )
             self._task_seq += 1
             report = {
                 "worker_id": self.worker_id,

@@ -1,0 +1,383 @@
+"""graftchaos (chaos/inject.py): plan grammar, injector semantics, the
+hook points in the RPC client and the worker, standby-pool visibility, and
+exactly-once task accounting across back-to-back pod kills."""
+
+import threading
+import time
+
+import pytest
+
+from elasticdl_tpu import chaos
+from elasticdl_tpu.chaos.inject import (
+    ChaosError,
+    ChaosFault,
+    ChaosInjector,
+    ChaosRpcDropped,
+    parse_plan,
+)
+from elasticdl_tpu.common import trace
+
+
+@pytest.fixture(autouse=True)
+def _reset_chaos_and_trace():
+    """Chaos and trace are process-global; every test leaves them off."""
+    yield
+    chaos.configure("")
+    chaos.set_context(rank=None, worker_id=None)
+    trace.configure(enabled=False)
+    trace.default().clear()
+
+
+# ---------------------------------------------------------------------------
+# plan grammar
+# ---------------------------------------------------------------------------
+
+class TestParsePlan:
+    def test_full_grammar(self):
+        plan = parse_plan(
+            "kill:rank=1,step=4;"
+            "stall:rank=0,point=prep,step=2,ms=500,count=2;"
+            "delay_rpc:method=GetTask,ms=100,count=3,skip=5;"
+            "drop_rpc:method=Heartbeat;"
+            "delay_ps:ms=50,count=0"
+        )
+        kinds = [f.kind for f in plan]
+        assert kinds == ["kill", "stall", "delay_rpc", "drop_rpc", "delay_ps"]
+        assert plan[0].rank == 1 and plan[0].step == 4
+        assert plan[1].point == "prep" and plan[1].ms == 500.0
+        assert plan[2].skip == 5 and plan[2].count == 3
+        assert plan[4].count == 0  # unlimited
+
+    def test_empty_is_empty(self):
+        assert parse_plan("") == []
+        assert parse_plan(" ; ") == []
+
+    @pytest.mark.parametrize("bad", [
+        "zap:ms=1",                   # unknown kind
+        "stall:rank=0",               # stall without ms
+        "delay_rpc:method=GetTask",   # delay without ms
+        "stall:ms=5,point=flush",     # unknown point
+        "kill:frequency=9",           # unknown key
+        "kill:rank",                  # malformed arg
+        # kind-inapplicable keys: these parse into match conditions no
+        # hook context can satisfy — a fault that silently never fires.
+        "stall:rank=0,ms=5,method=GetTask",   # method= is rpc-only
+        "delay_rpc:point=prep,ms=100",        # point= is stall-only
+        "kill:rank=0,ms=9",                   # a kill has no duration
+        "delay_ps:ms=5,rank=0",               # PS shard has no rank
+    ])
+    def test_malformed_plans_fail_loud(self, bad):
+        with pytest.raises(ChaosError):
+            parse_plan(bad)
+
+    def test_config_validates_plan(self):
+        from elasticdl_tpu.common.config import JobConfig
+
+        JobConfig(chaos="kill:rank=0,step=1").validate()
+        with pytest.raises(ChaosError):
+            JobConfig(chaos="zap:ms=1").validate()
+
+    def test_config_roundtrips_chaos_knobs(self):
+        from elasticdl_tpu.common.config import JobConfig
+
+        c = JobConfig(
+            chaos="stall:ms=5", gang_deadline_ms=250.0, gang_skip_budget=1
+        )
+        c2 = JobConfig.from_json(c.to_json())
+        assert (c2.chaos, c2.gang_deadline_ms, c2.gang_skip_budget) == (
+            "stall:ms=5", 250.0, 1
+        )
+        with pytest.raises(ValueError):
+            JobConfig(gang_deadline_ms=-1).validate()
+
+
+# ---------------------------------------------------------------------------
+# injector semantics
+# ---------------------------------------------------------------------------
+
+class TestInjector:
+    def test_disabled_is_noop(self):
+        inj = ChaosInjector()
+        assert not inj.enabled
+        inj.fire("worker:task", {"rank": 0, "step": 99})  # nothing armed
+
+    def test_module_hook_disabled_costs_one_check(self):
+        # The module helper returns before touching the injector at all.
+        chaos.configure("")
+        assert not chaos.enabled()
+        chaos.hook("worker:task", rank=0, step=10**9)
+
+    def test_step_and_rank_gate(self):
+        fired = []
+        inj = ChaosInjector(parse_plan("kill:rank=1,step=4"))
+        inj._exit = staticmethod(lambda code: fired.append(code))
+        inj.set_context(rank=0)
+        inj.fire("worker:task", {"step": 10})
+        assert fired == []  # wrong rank
+        inj.set_context(rank=1)
+        inj.fire("worker:task", {"step": 3})
+        assert fired == []  # step not reached
+        inj.fire("worker:task", {"step": 4})
+        assert fired == [chaos.CHAOS_KILL_EXIT_CODE]
+        inj.fire("worker:task", {"step": 5})
+        assert fired == [chaos.CHAOS_KILL_EXIT_CODE]  # count=1: once
+
+    def test_worker_id_gate_survives_relaunch_names(self):
+        """worker= matches the exact id, so a relaunched incarnation
+        (-rN suffix) does NOT re-match — an injected kill cannot
+        crash-loop its own replacement."""
+        fired = []
+        inj = ChaosInjector(parse_plan("kill:worker=job-worker-1,step=1"))
+        inj._exit = staticmethod(lambda code: fired.append(code))
+        inj.set_context(worker_id="job-worker-1-r1")
+        inj.fire("worker:task", {"step": 5})
+        assert fired == []
+        inj.set_context(worker_id="job-worker-1")
+        inj.fire("worker:task", {"step": 5})
+        assert fired == [chaos.CHAOS_KILL_EXIT_CODE]
+
+    def test_skip_then_count_window(self):
+        inj = ChaosInjector(parse_plan("drop_rpc:method=Heartbeat,count=2,skip=1"))
+        inj.fire("rpc:client", {"method": "GetTask"})  # method mismatch
+        inj.fire("rpc:client", {"method": "Heartbeat"})  # skipped occurrence
+        with pytest.raises(ChaosRpcDropped):
+            inj.fire("rpc:client", {"method": "Heartbeat"})
+        with pytest.raises(ChaosRpcDropped):
+            inj.fire("rpc:client", {"method": "Heartbeat"})
+        inj.fire("rpc:client", {"method": "Heartbeat"})  # budget exhausted
+        stats = inj.stats()
+        assert stats[0]["seen"] == 4 and stats[0]["fired"] == 2
+
+    def test_stall_sleeps_and_point_binds(self):
+        inj = ChaosInjector(parse_plan("stall:point=prep,ms=30,count=1"))
+        t0 = time.perf_counter()
+        inj.fire("worker:step", {})  # wrong point: no stall
+        assert time.perf_counter() - t0 < 0.02
+        t0 = time.perf_counter()
+        inj.fire("worker:prep", {})
+        assert time.perf_counter() - t0 >= 0.025
+
+    def test_fired_fault_emits_chaos_instant(self):
+        trace.configure(enabled=True)
+        trace.default().clear()
+        inj = ChaosInjector(parse_plan("delay_ps:ms=1"))
+        inj.fire("ps:pull", {"table": "t"})
+        events = trace.default().export()
+        names = [e["name"] for e in events]
+        assert "chaos:delay_ps" in names
+        ev = events[names.index("chaos:delay_ps")]
+        assert ev["cat"] == "chaos" and ev["args"]["point"] == "ps:pull"
+
+    def test_configure_rearms_and_resets_state(self):
+        chaos.configure("delay_ps:ms=1,count=1")
+        assert chaos.enabled()
+        chaos.hook("ps:pull")
+        assert chaos.default().stats()[0]["fired"] == 1
+        chaos.configure("delay_ps:ms=1,count=1")
+        assert chaos.default().stats()[0]["fired"] == 0
+        chaos.configure("")
+        assert not chaos.enabled()
+
+
+# ---------------------------------------------------------------------------
+# the rpc:client hook over a REAL gRPC round trip
+# ---------------------------------------------------------------------------
+
+def test_rpc_client_drop_and_delay_inject(devices):
+    from elasticdl_tpu.common.rpc import JsonRpcClient
+    from elasticdl_tpu.data.reader import Shard
+    from elasticdl_tpu.master.servicer import MasterServer, MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+
+    dispatcher = TaskDispatcher([Shard("f", 0, 10)])
+    server = MasterServer(MasterServicer(dispatcher), port=0).start()
+    client = JsonRpcClient(server.address)
+    try:
+        client.wait_ready(10.0)
+        chaos.configure(
+            "drop_rpc:method=Heartbeat,count=1;"
+            "delay_rpc:method=GetMembership,ms=40,count=1"
+        )
+        client.call("RegisterWorker", {"worker_id": "w0"})  # unmatched
+        with pytest.raises(ChaosRpcDropped):
+            client.call("Heartbeat", {"worker_id": "w0"})
+        # The drop budget is spent: the next beat goes through.
+        assert "version" in client.call("Heartbeat", {"worker_id": "w0"})
+        t0 = time.perf_counter()
+        client.call("GetMembership", {})
+        assert time.perf_counter() - t0 >= 0.035
+    finally:
+        chaos.configure("")
+        client.close()
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# worker hook points: an in-process job under stall faults completes and
+# the faults are attributable in the trace
+# ---------------------------------------------------------------------------
+
+def test_worker_job_completes_under_stall_faults(tmp_path, devices):
+    from elasticdl_tpu.common.config import JobConfig
+    from elasticdl_tpu.data.reader import create_data_reader
+    from elasticdl_tpu.data.synthetic import generate
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.models.spec import load_model_spec
+    from elasticdl_tpu.worker.worker import DirectMasterProxy, Worker
+
+    train = str(tmp_path / "train.rio")
+    generate("mnist", train, 96)
+    config = JobConfig(
+        model_def="mnist.model_spec",
+        training_data=train,
+        minibatch_size=16,
+        num_minibatches_per_task=2,
+        trace=True,
+        chaos="stall:point=task,ms=10,count=2;stall:point=prep,ms=5,count=1",
+    )
+    reader = create_data_reader(train)
+    dispatcher = TaskDispatcher(reader.create_shards(32))
+    servicer = MasterServicer(dispatcher)
+    spec = load_model_spec(
+        "elasticdl_tpu.models", "mnist.model_spec", compute_dtype="float32"
+    )
+    worker = Worker(
+        config, DirectMasterProxy(servicer), reader,
+        worker_id="w0", spec=spec, devices=devices,
+    )
+    result = worker.run()
+    assert result["tasks_done"] == 3 and servicer.dispatcher.finished()
+    status = servicer.JobStatus({})
+    assert status["duplicate_done"] == 0 and status["skipped"] == 0
+    # The injected stalls are attributable: the worker drained its ring
+    # into the heartbeat/report channel, so the chaos:stall instants sit
+    # in the master's banked per-worker buffer (plus any undrained tail).
+    dump = servicer.DumpTrace({})
+    names = [
+        e["name"]
+        for e in dump["processes"].get("w0", {}).get("events", [])
+    ] + [e["name"] for e in trace.default().export()]
+    assert names.count("chaos:stall") == 3
+
+
+# ---------------------------------------------------------------------------
+# standby-pool depth rides Heartbeat and JobStatus
+# ---------------------------------------------------------------------------
+
+def test_standby_depth_rides_heartbeat_and_job_status():
+    from elasticdl_tpu.data.reader import Shard
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+
+    servicer = MasterServicer(TaskDispatcher([Shard("f", 0, 10)]))
+    servicer.RegisterWorker({"worker_id": "w0"})
+    resp = servicer.Heartbeat({"worker_id": "w0"})
+    assert "standby_pool" not in resp  # no pool wired: absent, not 0
+    depth = {"n": 1}
+    servicer.set_standby_depth(lambda: depth["n"])
+    assert servicer.Heartbeat({"worker_id": "w0"})["standby_pool"] == 1
+    depth["n"] = 0  # drained pool is VISIBLE before the next failure
+    assert servicer.Heartbeat({"worker_id": "w0"})["standby_pool"] == 0
+    assert servicer.JobStatus({})["standby_pool"] == 0
+    servicer.set_standby_depth(lambda: None)  # backend without a pool
+    assert "standby_pool" not in servicer.Heartbeat({"worker_id": "w0"})
+
+
+def test_pod_manager_standby_depth_delegates():
+    from elasticdl_tpu.common.config import JobConfig
+    from elasticdl_tpu.master.pod_manager import (
+        FakePodBackend,
+        PodManager,
+        ProcessPodBackend,
+    )
+
+    config = JobConfig()
+    assert PodManager(FakePodBackend(), config).standby_depth() is None
+    cold = ProcessPodBackend(warm_standby=False)
+    assert PodManager(cold, config).standby_depth() is None
+    warm = ProcessPodBackend(warm_standby=True)
+    assert PodManager(warm, config).standby_depth() == 0  # pool not filled yet
+
+
+# ---------------------------------------------------------------------------
+# exactly-once accounting across back-to-back kills (FakePodBackend fleet)
+# ---------------------------------------------------------------------------
+
+def test_exactly_once_accounting_across_two_pod_kills():
+    """Kill two ranks back-to-back: each dead worker's in-flight tasks
+    requeue exactly once through the membership cascade, every task
+    reports done exactly once, and the duplicate-done counter stays 0."""
+    from elasticdl_tpu.common.config import JobConfig
+    from elasticdl_tpu.data.reader import Shard
+    from elasticdl_tpu.master.pod_manager import FakePodBackend, PodManager
+    from elasticdl_tpu.master.rendezvous import RendezvousServer
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+
+    shards = [Shard("f", i * 10, (i + 1) * 10) for i in range(6)]
+    dispatcher = TaskDispatcher(shards)
+    rendezvous = RendezvousServer(heartbeat_timeout_s=60.0)
+    servicer = MasterServicer(dispatcher, rendezvous=rendezvous)
+    backend = FakePodBackend()
+    manager = PodManager(
+        backend,
+        JobConfig(num_workers=2, relaunch_on_worker_failure=True),
+    )
+    manager.add_listener(
+        lambda name, phase: rendezvous.remove(name)
+        if phase in ("Failed", "Succeeded", "Deleted") else None
+    )
+    manager.start(2)
+    pods = sorted(backend.pods)
+    for pod in pods:
+        servicer.RegisterWorker({"worker_id": pod})
+
+    # Each worker leases two tasks; one task per worker completes before
+    # the kills, the other is in flight when its worker dies.
+    leases = {pod: servicer.GetTask({"worker_id": pod, "lease": 2}) for pod in pods}
+    done_ids = []
+    for pod in pods:
+        first = leases[pod]["tasks"][0]
+        servicer.ReportTaskResult({
+            "worker_id": pod, "task_id": first["task_id"],
+            "task_type": "training", "success": True,
+        })
+        done_ids.append(first["task_id"])
+
+    in_flight = {
+        pod: leases[pod]["tasks"][1]["task_id"] for pod in pods
+    }
+    backend.fail_pod(pods[0])  # first kill: splice path would adopt a spare
+    backend.fail_pod(pods[1])  # second, back-to-back
+    # Both dead workers' in-flight tasks are back in todo exactly once.
+    counts = dispatcher.counts()
+    assert counts["doing"] == 0 and counts["todo"] == 4
+
+    # A LATE success from a dead worker is rejected AND counted: its task
+    # already requeued, so accepting it would double-train the shard.
+    resp = servicer.ReportTaskResult({
+        "worker_id": pods[0], "task_id": in_flight[pods[0]],
+        "task_type": "training", "success": True,
+    })
+    assert resp["accepted"] is False
+    assert dispatcher.counts()["duplicate_done"] == 1
+
+    # The relaunched incarnations drain the queue; accounting stays exact.
+    survivors = [n for n in manager.live_pods()]
+    assert len(survivors) == 2 and set(survivors) != set(pods)
+    for pod in survivors:
+        servicer.RegisterWorker({"worker_id": pod})
+    while True:
+        resp = servicer.GetTask({"worker_id": survivors[0]})
+        if resp["task"] is None:
+            break
+        servicer.ReportTaskResult({
+            "worker_id": survivors[0], "task_id": resp["task"]["task_id"],
+            "task_type": "training", "success": True,
+        })
+    final = dispatcher.counts()
+    assert final["finished"] and final["done"] == 6
+    # done == shards: the requeued tasks trained once each; the one late
+    # duplicate stayed rejected.
+    assert final["duplicate_done"] == 1 and final["abandoned"] == 0

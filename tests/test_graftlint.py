@@ -943,6 +943,89 @@ def test_trace_discipline_waivable_and_exempts_error_paths():
     assert _lint(src, [TraceDisciplinePass()]) == []
 
 
+# ---- chaos-discipline ----
+
+CHAOS_SEEDED = """
+    from elasticdl_tpu import chaos
+
+    class Worker:
+        # hot-path: the steady-state task loop
+        def poll(self):
+            chaos.hook("worker:task", rank=0, step=1)
+            chaos.configure("stall:ms=5")  # plan mutation on the hot path: finding
+"""
+
+CHAOS_CLEAN = """
+    from elasticdl_tpu import chaos
+
+    class Worker:
+        def __init__(self, config):
+            # Arming at a process boundary is the intended pattern.
+            chaos.configure(config.chaos)
+            chaos.set_context(rank=0)
+
+        # hot-path: the steady-state task loop
+        def poll(self):
+            chaos.hook("worker:task", rank=0, step=1)
+"""
+
+
+def test_chaos_discipline_seeded_and_clean():
+    from elasticdl_tpu.analysis.chaos_discipline import ChaosDisciplinePass
+
+    findings = _lint(CHAOS_SEEDED, [ChaosDisciplinePass()])
+    assert _rules(findings) == {"chaos-discipline"}
+    assert len(findings) == 1
+    assert _lint(CHAOS_CLEAN, [ChaosDisciplinePass()]) == []
+
+
+def test_chaos_discipline_flags_fire_set_context_and_construction():
+    from elasticdl_tpu.analysis.chaos_discipline import ChaosDisciplinePass
+
+    src = """
+        class W:
+            # hot-path
+            def step(self, chaos, inj):
+                chaos.default().fire("worker:task", {})
+                inj.set_context(rank=1)
+                ChaosInjector()
+    """
+    findings = _lint(src, [ChaosDisciplinePass()])
+    assert len(findings) == 3
+
+
+def test_chaos_discipline_ignores_unrelated_receivers():
+    from elasticdl_tpu.analysis.chaos_discipline import ChaosDisciplinePass
+
+    src = """
+        class W:
+            # hot-path
+            def step(self, model, logger):
+                model.configure(lr=0.1)   # not a chaos receiver
+                logger.fire("event")      # nor this
+    """
+    assert _lint(src, [ChaosDisciplinePass()]) == []
+
+
+def test_chaos_discipline_waivable_and_exempts_error_paths():
+    from elasticdl_tpu.analysis.chaos_discipline import ChaosDisciplinePass
+
+    src = """
+        from elasticdl_tpu import chaos
+
+        class W:
+            # hot-path
+            def step(self):
+                # graftlint: allow[chaos-discipline] deliberate hot rearm in a test harness
+                chaos.configure("stall:ms=1")
+                try:
+                    pass
+                except Exception:
+                    chaos.configure("")  # error path: exempt
+    """
+    assert _lint(src, [ChaosDisciplinePass()]) == []
+
+
 # ---- the repo-wide gate ----
 
 def test_repo_lints_clean():

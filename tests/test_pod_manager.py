@@ -353,6 +353,12 @@ import json, os, sys, time
 go = os.environ.get("ELASTICDL_STANDBY_GO_FILE")
 out = os.environ["STANDBY_TEST_OUT"]
 if go:
+    # Mirror worker.main's standby protocol: a configurable "import
+    # warmup", then the atomic readiness marker adoption gates on.
+    time.sleep(float(os.environ.get("STANDBY_WARMUP_S", "0")))
+    with open(go + ".ready.tmp", "w") as f:
+        f.write(str(os.getpid()))
+    os.replace(go + ".ready.tmp", go + ".ready")
     while not os.path.exists(go):
         time.sleep(0.01)
     payload = json.loads(open(go).read())
@@ -383,6 +389,14 @@ def _wait(cond, timeout=15.0, what="condition"):
     raise AssertionError(f"timed out waiting for {what}")
 
 
+def _spare_ready(backend) -> bool:
+    """A parked spare exists AND has published its readiness marker (the
+    adoption gate)."""
+    with backend._lock:
+        spares = list(backend._standby)
+    return any(os.path.exists(go + ".ready") for _, go, _ in spares)
+
+
 def test_warm_standby_adopted_on_relaunch(tmp_path):
     script = tmp_path / "stub.py"
     script.write_text(STANDBY_STUB)
@@ -404,7 +418,7 @@ def test_warm_standby_adopted_on_relaunch(tmp_path):
         assert (tmp_path / "ran.w-0").read_text().split(":") [::2] == [
             "cold", "0",
         ]
-        _wait(lambda: len(backend._standby) == 1, what="spare parked")
+        _wait(lambda: _spare_ready(backend), what="spare parked + ready")
         spare_pid = backend._standby[0][0].pid
 
         # Adoption works across SLOTS (review r5: per-pod slot must ride the
@@ -450,7 +464,7 @@ def test_dead_spare_falls_back_to_cold_spawn(tmp_path):
     }
     try:
         backend.start_pod("w-0", env)
-        _wait(lambda: len(backend._standby) == 1, what="spare parked")
+        _wait(lambda: _spare_ready(backend), what="spare parked + ready")
         backend._standby[0][0].kill()  # the spare dies while parked
         backend._standby[0][0].wait(timeout=10)
 
@@ -465,4 +479,77 @@ def test_dead_spare_falls_back_to_cold_spawn(tmp_path):
             what="pool refilled",
         )
     finally:
+        backend.close()
+
+
+def test_standby_churn_two_kills_first_warm_second_cold(tmp_path):
+    """Back-to-back kills against a pool of ONE: the first relaunch
+    splices the parked spare in, the second (pool still refilling or
+    drained) degrades to a cold spawn, and the pool refills behind both —
+    spares are latency, never a correctness dependency.  The standby
+    lifecycle instants (standby:spawn/adopt/refill) make the whole cycle
+    attributable in a merged trace."""
+    from elasticdl_tpu.common import trace
+
+    script = tmp_path / "stub.py"
+    script.write_text(STANDBY_STUB)
+    backend = ProcessPodBackend(
+        argv=[sys.executable, str(script)], warm_standby=True,
+        standby_pool=1,
+    )
+
+    def env(name, slot):
+        return {
+            "ELASTICDL_WORKER_ID": name,
+            "ELASTICDL_WORKER_SLOT": str(slot),
+            "STANDBY_TEST_OUT": str(tmp_path),
+            # A visible "import warmup": the refill spare spawned behind
+            # the first adoption is NOT ready when the second relaunch
+            # arrives, which is exactly the burst-beyond-the-pool case.
+            "STANDBY_WARMUP_S": "1.0",
+        }
+
+    trace.configure(enabled=True)
+    trace.default().clear()
+    try:
+        backend.start_pod("w-0", env("w-0", 0))
+        backend.start_pod("w-1", env("w-1", 1))
+        _wait(lambda: (tmp_path / "ran.w-0").exists(), what="w-0 boot")
+        _wait(lambda: (tmp_path / "ran.w-1").exists(), what="w-1 boot")
+        _wait(lambda: _spare_ready(backend), what="spare parked + ready")
+        spare_pid = backend._standby[0][0].pid
+
+        # Kill both ranks back-to-back, then relaunch both immediately —
+        # the second relaunch arrives while the pool holds at most the
+        # one spare the first relaunch is about to take.
+        for name in ("w-0", "w-1"):
+            with backend._lock:
+                proc = backend._procs[name]
+            proc.kill()
+            proc.wait(timeout=10)
+        backend.start_pod("w-0-r1", env("w-0-r1", 0))
+        backend.start_pod("w-1-r1", env("w-1-r1", 1))
+        _wait(lambda: (tmp_path / "ran.w-0-r1").exists(), what="w-0-r1 boot")
+        _wait(lambda: (tmp_path / "ran.w-1-r1").exists(), what="w-1-r1 boot")
+        first = (tmp_path / "ran.w-0-r1").read_text().split(":")
+        second = (tmp_path / "ran.w-1-r1").read_text().split(":")
+        # First splices the parked spare (same pid), second went cold.
+        assert first[0] == "warm" and int(first[1]) == spare_pid
+        assert second[0] == "cold"
+        # The pool healed behind the churn.
+        _wait(lambda: backend.standby_depth() == 1, what="pool refilled")
+
+        names = [e["name"] for e in trace.default().export()]
+        assert "standby:spawn" in names     # initial park
+        assert "standby:adopt" in names     # the splice
+        assert "standby:refill" in names    # the post-adoption top-up
+        # The splice timeline's adopt stage rides the same moment.
+        splices = [
+            e for e in trace.default().export()
+            if e["name"] == "elastic:splice"
+        ]
+        assert any(e["args"]["stage"] == "adopt" for e in splices)
+    finally:
+        trace.configure(enabled=False)
+        trace.default().clear()
         backend.close()

@@ -51,6 +51,37 @@ def code_rev(repo: Optional[str] = None) -> str:
         return ""
 
 
+class ArtifactRun:
+    """Capture ``code_rev`` at TOOL ENTRY and stamp it at write time.
+
+    The pattern c5125b1 fixed by hand in straggler_report.py, made
+    un-regressable: a tool whose RUN rewrites committed outputs (merged
+    traces, prior artifacts) dirties its own tree, so a stamp-time
+    ``code_rev()`` would mark every artifact "-dirty" from the tool's OWN
+    output files.  The code that produced the measurement is the tree as
+    it stood on entry — construct one of these FIRST, write through it
+    LAST.  A caller-supplied ``code_rev`` key in the result still wins
+    (setdefault), so tools measuring a different tree can override.
+    """
+
+    def __init__(self, repo: Optional[str] = None):
+        self.code_rev = code_rev(repo)
+
+    def write(
+        self,
+        result: dict,
+        default_name: str,
+        env_var: str = "",
+        path: Optional[str] = None,
+        log: Optional[Callable[[str], None]] = None,
+    ) -> str:
+        stamped = dict(result)
+        stamped.setdefault("code_rev", self.code_rev)
+        return write_artifact(
+            stamped, default_name, env_var=env_var, path=path, log=log
+        )
+
+
 #: Shared log-spaced histogram bucket edges (MILLISECONDS) for
 #: ``latency_stats(..., buckets=True)``.  One FIXED grid across every
 #: artifact (serving_bench, ps_bench, straggler_report) so tail shapes are

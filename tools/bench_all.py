@@ -249,6 +249,21 @@ def main() -> None:
         "examples/sec",
     )
     ap.add_argument(
+        "--chaos", action="store_true",
+        help="also run the chaos bench (tools/chaos_bench.py) after the "
+        "training configs; it stamps its own CHAOS artifact — recovery "
+        "time decomposed over the splice timeline, goodput-under-churn "
+        "vs a fault-free baseline, skip accounting, and the explicit "
+        "zero-double-train check",
+    )
+    ap.add_argument(
+        "--chaos-smoke", action="store_true",
+        help="run ONLY the chaos smoke: a tiny 1-worker kill+recover "
+        "through the full master stack asserting recovery completes and "
+        "nothing trains twice — the tier-1-adjacent CI check that the "
+        "fault path works without the full gang run",
+    )
+    ap.add_argument(
         "--trace-smoke", action="store_true",
         help="run ONLY the grafttrace overhead smoke: the ingest bench's "
         "--trace A/B (recorder off vs on, same workload) must land under "
@@ -256,6 +271,25 @@ def main() -> None:
         "production job is safe (docs/observability.md)",
     )
     args = ap.parse_args()
+    if args.chaos_smoke:
+        # CPU-harness subprocess fleet, no chip probe: the smoke measures
+        # the recovery machinery, not the accelerator.
+        from tools.chaos_bench import run_smoke
+
+        result = run_smoke(
+            lambda m: print(f"[chaos-smoke] {m}", file=sys.stderr, flush=True)
+        )
+        print(json.dumps(result), flush=True)
+        if result["problems"]:
+            for p in result["problems"]:
+                print(f"[chaos-smoke] FAIL: {p}", file=sys.stderr)
+            raise SystemExit(1)
+        print(
+            "[chaos-smoke] PASS: recovery "
+            f"{result['recovery'].get('recovery_time_ms')} ms, zero "
+            "double-train", file=sys.stderr,
+        )
+        return
     if args.trace_smoke:
         # Host-only (no chip probe): the smoke measures the recorder, not
         # the accelerator, and must run on any box.
@@ -319,6 +353,12 @@ def main() -> None:
         # Subprocess-driven (its children pin their own fake device
         # counts), so running it after the in-process configs is safe.
         optshard_main([])
+    if args.chaos:
+        from tools.chaos_bench import main as chaos_main
+
+        # Subprocess-fleet driven (the bench process itself stays
+        # jax-free), so running it after the in-process configs is safe.
+        chaos_main([])
     if args.serving:
         from tools.serving_bench import run_bench
 

@@ -203,16 +203,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     args = ap.parse_args(argv)
     log = lambda m: print(f"[straggler] {m}", file=sys.stderr, flush=True)
-    rev = None
+    run = None
     if args.artifact is not None:
-        # Captured BEFORE run_gang rewrites the committed trace artifacts:
-        # code_rev counts any modified tracked file as dirt, so a stamp-
-        # time read would mark every --run-gang artifact "-dirty" from its
-        # OWN output files.  The code producing the measurement is the
-        # tree as it stands on entry.
-        from tools.artifact import code_rev
+        # ArtifactRun captures code_rev at ENTRY, before run_gang rewrites
+        # the committed trace artifacts (tools/artifact.py documents why a
+        # stamp-time read would mark every --run-gang artifact "-dirty"
+        # from its own outputs).
+        from tools.artifact import ArtifactRun
 
-        rev = code_rev()
+        run = ArtifactRun()
 
     if bool(args.run_gang) + bool(args.trace) + bool(args.raw) != 1:
         print(
@@ -230,16 +229,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         # The overhead A/B belongs in the SAME artifact as the skew
         # numbers: "stragglers are measurable AND measuring them is ~free"
         # is one claim, checkable from one file.
-        from tools.artifact import write_artifact
         from tools.ingest_bench import trace_overhead_ab
 
         overhead = trace_overhead_ab(log)
-        write_artifact(
+        run.write(
             {
                 "metric": "gang_trace_straggler_report",
                 **report,
                 "trace_overhead_ingest_ab": overhead,
-                "code_rev": rev,
             },
             ARTIFACT_NAME,
             env_var="TRACE_OUT",
