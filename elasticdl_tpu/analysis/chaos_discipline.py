@@ -17,22 +17,22 @@ FRAMEWORK a perturbation of its own even with no fault armed — the exact
 failure mode the one-attribute-check design exists to rule out.  This
 pass keeps the split enforced.
 
-Scope notes, mirroring ``trace-discipline``:
-
-- ``except`` handler bodies and nested ``def``/``lambda`` bodies are
-  exempt (error paths and deferred execution own their own time);
-- the non-hook names are matched on chaos-shaped receivers only
-  (``chaos``/``inj``/``injector``/``_INJ``), so an unrelated object's
-  ``configure()`` is never punished; ``ChaosInjector`` construction is
-  matched by name anywhere.
+Traversal and exemption scope (handlers/nested defs exempt, no phase
+excuse) are the shared ``HotPathCallDisciplinePass`` contract — one body
+with ``trace-discipline``, so the family cannot drift.  The non-hook
+names are matched on chaos-shaped receivers only (``chaos``/``inj``/
+``injector``/``_INJ``), so an unrelated object's ``configure()`` is never
+punished; ``ChaosInjector`` construction is matched by name anywhere.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Iterable, List
 
-from elasticdl_tpu.analysis.core import Finding, LintPass, SourceFile, attr_chain
+from elasticdl_tpu.analysis.core import (
+    HotPathCallDisciplinePass,
+    receiver_hinted,
+)
 
 #: Non-hook chaos API: flagged in a hot-path body when the receiver looks
 #: like the chaos module/injector.
@@ -51,22 +51,10 @@ def _is_chaos_setup_call(node: ast.Call) -> bool:
         return False
     if f.attr not in _SETUP_ATTRS:
         return False
-    chain = attr_chain(f)
-    if chain:
-        recv = chain.rsplit(".", 1)[0].split(".")[-1]
-        return recv in _CHAOS_RECEIVER_HINTS
-    # Dynamic receiver (``chaos.default().fire(...)``): the inner call's
-    # own chain carries the hint.
-    inner = f.value
-    if isinstance(inner, ast.Call):
-        ichain = attr_chain(inner.func)
-        return any(
-            part in _CHAOS_RECEIVER_HINTS for part in ichain.split(".")
-        )
-    return False
+    return receiver_hinted(f, _CHAOS_RECEIVER_HINTS)
 
 
-class ChaosDisciplinePass(LintPass):
+class ChaosDisciplinePass(HotPathCallDisciplinePass):
     name = "chaos-discipline"
     description = (
         "functions marked '# hot-path' may cross fault-injection points "
@@ -74,33 +62,12 @@ class ChaosDisciplinePass(LintPass):
         "context mutation and direct injector use (fire/configure/"
         "set_context/parse_plan/ChaosInjector) are findings"
     )
+    message = (
+        "chaos setup/injector API inside a '# hot-path' function — "
+        "hot-path call sites use the no-op-when-disabled "
+        "chaos.hook(...) only; arm plans at process boundaries, "
+        "or waive with a reason"
+    )
 
-    def run(self, src: SourceFile) -> Iterable[Finding]:
-        findings: List[Finding] = []
-        for node in ast.walk(src.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                if src.is_hot_path(node.lineno):
-                    self._walk(src, node.body, findings)
-        return findings
-
-    def _walk(self, src, body, findings) -> None:
-        for node in body:
-            self._visit(src, node, findings)
-
-    def _visit(self, src, node, findings) -> None:
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
-            return  # deferred execution: not this function's hot path
-        if isinstance(node, ast.Try):
-            for stmt in node.body + node.orelse + node.finalbody:
-                self._visit(src, stmt, findings)
-            return  # handlers (error path) skipped
-        if isinstance(node, ast.Call) and _is_chaos_setup_call(node):
-            findings.append(Finding(
-                self.name, src.path, node.lineno,
-                "chaos setup/injector API inside a '# hot-path' function — "
-                "hot-path call sites use the no-op-when-disabled "
-                "chaos.hook(...) only; arm plans at process boundaries, "
-                "or waive with a reason",
-            ))
-        for child in ast.iter_child_nodes(node):
-            self._visit(src, child, findings)
+    def is_flagged_call(self, node: ast.Call) -> bool:
+        return _is_chaos_setup_call(node)

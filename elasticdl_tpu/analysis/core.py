@@ -235,6 +235,71 @@ def attr_chain(node: ast.AST) -> str:
     return ""
 
 
+def receiver_hinted(func: ast.Attribute, hints: Sequence[str]) -> bool:
+    """True when an attribute call's RECEIVER looks like one of ``hints``
+    — either the chain's last segment before the method name
+    (``trace.export()`` -> ``trace``), or, for a dynamic receiver whose
+    chain bottoms out in a call (``trace.default().export()``), any
+    segment of the inner call's own chain.  The shared matcher behind the
+    trace-/chaos-discipline passes: ambiguous method verbs (``export``,
+    ``configure``) only flag on receivers shaped like the guarded API."""
+    chain = attr_chain(func)
+    if chain:
+        recv = chain.rsplit(".", 1)[0].split(".")[-1]
+        return recv in hints
+    inner = func.value
+    if isinstance(inner, ast.Call):
+        ichain = attr_chain(inner.func)
+        return any(part in hints for part in ichain.split("."))
+    return False
+
+
+class HotPathCallDisciplinePass(LintPass):
+    """Shared shape of the trace-/chaos-discipline rules: inside a
+    ``# hot-path`` function's steady-state body, calls matching the
+    subclass's predicate are findings.  Exemptions — identical across the
+    family by design, so a traversal fix lands in both rules at once:
+
+    - nested ``def``/``lambda`` bodies (deferred execution owns its own
+      time);
+    - ``except`` handler bodies (the error path), while ``try``/``else``/
+      ``finally`` bodies stay in scope;
+    - NO ``phases.phase(...)`` excuse, unlike hot-path-sync: the guarded
+      APIs are control-plane surfaces, not accountable hot-path phases.
+
+    Subclasses set ``name``/``description``/``message`` and implement
+    ``is_flagged_call``."""
+
+    #: Finding text appended at each flagged call site.
+    message: str = ""
+
+    def is_flagged_call(self, node: ast.Call) -> bool:
+        raise NotImplementedError
+
+    def run(self, src: SourceFile) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if src.is_hot_path(node.lineno):
+                    for stmt in node.body:
+                        self._visit(src, stmt, findings)
+        return findings
+
+    def _visit(self, src, node, findings) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return  # deferred execution: not this function's hot path
+        if isinstance(node, ast.Try):
+            for stmt in node.body + node.orelse + node.finalbody:
+                self._visit(src, stmt, findings)
+            return  # handlers (error path) skipped
+        if isinstance(node, ast.Call) and self.is_flagged_call(node):
+            findings.append(Finding(
+                self.name, src.path, node.lineno, self.message,
+            ))
+        for child in ast.iter_child_nodes(node):
+            self._visit(src, child, findings)
+
+
 def iter_file_paths(paths: Sequence[str]) -> List[str]:
     """Every ``.py`` file under ``paths`` (files pass through), skipping
     ``__pycache__`` and hidden directories, sorted for stable output."""

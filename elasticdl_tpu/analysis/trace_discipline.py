@@ -11,14 +11,9 @@ exists to rule out.  This pass keeps the split enforced: any call whose
 attribute name is one of the export methods, inside a ``# hot-path``
 function's steady-state body, is a finding.
 
-Scope notes, mirroring ``hot-path-sync``'s conventions:
-
-- ``except`` handler bodies and nested ``def``/``lambda`` bodies are
-  exempt (error paths and deferred execution own their own time);
-- unlike blocking calls, a ``phases.phase(...)`` boundary does NOT excuse
-  an export — a drain is control-plane work, not an accountable phase of
-  the hot path; waive with a reason if a hot-path drain is ever truly
-  intended.
+Traversal and exemption scope (handlers/nested defs exempt, no phase
+excuse) are the shared ``HotPathCallDisciplinePass`` contract — one body
+with ``chaos-discipline``, so the family cannot drift.
 
 The export-method names are distinctive enough (``drain_slice``,
 ``chrome_events``) that receiver resolution is unnecessary — matching the
@@ -30,9 +25,11 @@ unrelated exporters).
 from __future__ import annotations
 
 import ast
-from typing import Iterable, List
 
-from elasticdl_tpu.analysis.core import Finding, LintPass, SourceFile, attr_chain
+from elasticdl_tpu.analysis.core import (
+    HotPathCallDisciplinePass,
+    receiver_hinted,
+)
 
 #: Export-API attribute names that always flag in a hot-path body.
 _EXPORT_ATTRS = {"drain_slice", "chrome_events"}
@@ -50,54 +47,22 @@ def _is_export_call(node: ast.Call) -> bool:
     if f.attr in _EXPORT_ATTRS:
         return True
     if f.attr == "export":
-        chain = attr_chain(f)
-        if chain:
-            recv = chain.rsplit(".", 1)[0].split(".")[-1]
-            return recv in _TRACE_RECEIVER_HINTS
-        # Dynamic receiver (e.g. ``trace.default().export()``): the inner
-        # call's own name is the hint.
-        inner = f.value
-        if isinstance(inner, ast.Call):
-            ichain = attr_chain(inner.func)
-            return any(
-                part in _TRACE_RECEIVER_HINTS for part in ichain.split(".")
-            )
+        return receiver_hinted(f, _TRACE_RECEIVER_HINTS)
     return False
 
 
-class TraceDisciplinePass(LintPass):
+class TraceDisciplinePass(HotPathCallDisciplinePass):
     name = "trace-discipline"
     description = (
         "functions marked '# hot-path' may emit trace events only through "
         "the non-blocking ring API (span/instant/add_complete); export "
         "calls (drain_slice/export/chrome_events) are findings"
     )
+    message = (
+        "trace export/drain inside a '# hot-path' function — ship "
+        "slices from a control-plane boundary (heartbeat/report) "
+        "instead, or waive with a reason"
+    )
 
-    def run(self, src: SourceFile) -> Iterable[Finding]:
-        findings: List[Finding] = []
-        for node in ast.walk(src.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                if src.is_hot_path(node.lineno):
-                    self._walk(src, node.body, findings)
-        return findings
-
-    def _walk(self, src, body, findings) -> None:
-        for node in body:
-            self._visit(src, node, findings)
-
-    def _visit(self, src, node, findings) -> None:
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
-            return  # deferred execution: not this function's hot path
-        if isinstance(node, ast.Try):
-            for stmt in node.body + node.orelse + node.finalbody:
-                self._visit(src, stmt, findings)
-            return  # handlers (error path) skipped
-        if isinstance(node, ast.Call) and _is_export_call(node):
-            findings.append(Finding(
-                self.name, src.path, node.lineno,
-                "trace export/drain inside a '# hot-path' function — ship "
-                "slices from a control-plane boundary (heartbeat/report) "
-                "instead, or waive with a reason",
-            ))
-        for child in ast.iter_child_nodes(node):
-            self._visit(src, child, findings)
+    def is_flagged_call(self, node: ast.Call) -> bool:
+        return _is_export_call(node)

@@ -157,6 +157,14 @@ class MasterServicer:
         self._gang_arrivals: Dict[str, tuple] = {}  # guarded-by: _group_lock
         self._gang_head: tuple = (0, None)  # (seq, first-ask t)  guarded-by: _group_lock
         self._skipped_ranks: Dict[str, int] = {}  # guarded-by: _lock
+        # Ranks maybe_skip_straggler evicted whose processes are still
+        # alive: their background liveness beats keep arriving, and the
+        # rendezvous heartbeat would REVIVE an unknown worker — re-adding
+        # an unconfirmable wedged rank to the very membership the skip
+        # just cut it from.  Heartbeat refuses the revival while a rank
+        # is marked here; a deliberate RegisterWorker (the restart path)
+        # clears the mark.  Bounded by the job's historical rank count.
+        self._deadline_evicted: set = set()  # guarded-by: _lock
         # Warm-standby pool introspection (r13): master main wires the
         # PodManager's depth here; Heartbeat/JobStatus republish it so a
         # DRAINED pool is visible before the next failure needs it.
@@ -420,6 +428,9 @@ class MasterServicer:
             self._skipped_ranks[straggler] = (
                 self._skipped_ranks.get(straggler, 0) + 1
             )
+            # Marked BEFORE rendezvous.remove below: a beat landing in the
+            # gap would otherwise revive the rank the moment it is removed.
+            self._deadline_evicted.add(straggler)
         # Skip-accounted requeue BEFORE the membership bump: the generic
         # invalidation path (_on_membership_change) would requeue the same
         # tasks without charging the skip budget, and unbounded free skips
@@ -706,6 +717,11 @@ class MasterServicer:
                 f"protocol version mismatch: worker speaks v{proto}, "
                 f"master speaks v{PROTOCOL_VERSION} — upgrade the older side"
             )
+        with self._lock:
+            # A deliberate (re-)registration is the restart path out of a
+            # deadline eviction — lift the Heartbeat revival block first so
+            # the rank's beats count again the moment it is a member.
+            self._deadline_evicted.discard(req["worker_id"])
         self.rendezvous.register(req["worker_id"], req.get("address", ""))
         with self._lock:
             self._known_workers.add(req["worker_id"])
@@ -716,6 +732,8 @@ class MasterServicer:
         this before restarting: the version bump makes every peer resync
         instead of wedging in a collective the failed member will never
         join (and requeues the member's in-flight tasks)."""
+        with self._lock:
+            self._deadline_evicted.discard(req["worker_id"])
         return {"version": self.rendezvous.remove(req["worker_id"])}
 
     # hot-path: every worker beats every poll interval
@@ -731,12 +749,63 @@ class MasterServicer:
         # straggler — the beat both FEEDS the per-rank progress signal
         # (gang_seq, the dispatch counter boundary asks cannot carry) and
         # drives the skip decision on it.
-        gang_seq = req.get("gang_seq")
-        if gang_seq is not None and self._gang_deadline_s:
-            self.note_gang_progress(
-                req["worker_id"], int(gang_seq), req.get("version")
-            )
-        self.maybe_skip_straggler()
+        if self._gang_deadline_s:
+            # Whole block gated: with the deadline off, _deadline_evicted
+            # has no writer — non-gang jobs keep the pre-r13 per-beat cost.
+            with self._lock:
+                evicted = req["worker_id"] in self._deadline_evicted
+            if not evicted:
+                gang_seq = req.get("gang_seq")
+                if gang_seq is not None:
+                    self.note_gang_progress(
+                        req["worker_id"], int(gang_seq), req.get("version")
+                    )
+                self.maybe_skip_straggler()
+                # Re-check: the skip above can evict THIS rank — the
+                # straggler's own beat is often the one that trips the
+                # deadline — and a concurrent beat can evict it at any
+                # point before the rendezvous call below.
+                with self._lock:
+                    evicted = req["worker_id"] in self._deadline_evicted
+            if evicted:
+                # A refused beat can be arbitrarily delayed between the
+                # checks above and here while the rank deliberately
+                # re-registers (clearing the mark): confirm the mark one
+                # final time right before acting, so the remove below
+                # cannot eject a legitimately re-joined member.  This
+                # shrinks the raced-removal window from an arbitrary
+                # handler delay to a few instructions (it cannot be zero:
+                # holding _lock across the remove would invert against
+                # the membership listener, which takes _lock).
+                with self._lock:
+                    evicted = req["worker_id"] in self._deadline_evicted
+            if evicted:
+                # Deadline-skipped rank whose process is still alive: its
+                # beat must NOT feed gang progress (it is no longer a
+                # member of the boundary) and must NOT reach
+                # rendezvous.heartbeat, whose unknown-worker path would
+                # re-register it unconfirmed — undoing the eviction and
+                # wedging the reform on a rank that cannot confirm.  Two
+                # self-healing undos cover the inherent check-then-act
+                # races against a concurrent beat's eviction: drop any
+                # stale arrival this rank's note_gang_progress re-seeded
+                # after the skip popped it (left behind, it could fake a
+                # SECOND eviction of the same stall a deadline later,
+                # double-charging the skip budget), and the remove below
+                # both reads the CURRENT version (a bump-free read when
+                # the rank is already out, the steady state) and undoes a
+                # raced revival.  The version mismatch drives the rank's
+                # own restart (loop heartbeat → WorkerRestartRequired, or
+                # the death-push grace); the relaunch re-registers
+                # deliberately, clearing the mark.
+                with self._group_lock:
+                    self._gang_arrivals.pop(req["worker_id"], None)
+                return {
+                    "version": self.rendezvous.remove(req["worker_id"]),
+                    "server_ts_us": trace.now_us(),
+                }
+            # Mark lifted while this beat was in flight: fall through to
+            # the normal beat — the rank is a member again.
         resp = {
             "version": self.rendezvous.heartbeat(
                 req["worker_id"], req.get("version")

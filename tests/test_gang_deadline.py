@@ -261,6 +261,64 @@ def test_gang_progress_is_version_gated_and_monotonic():
     assert servicer.maybe_skip_straggler() is None  # nobody actually lags
 
 
+def test_deadline_evicted_rank_beats_cannot_revive_membership():
+    """The straggler's process is often still ALIVE after the skip (a
+    stall, not a crash) — its background liveness beat keeps arriving,
+    and the rendezvous heartbeat's unknown-worker path would re-register
+    it unconfirmed, undoing the eviction and wedging the reform on a
+    rank that cannot confirm the new version.  The servicer must refuse
+    the revival (and the rank's stale gang progress) until the rank
+    deliberately re-registers — its restart path."""
+    servicer, clock = _gang()
+    rv = servicer.rendezvous
+    v = _join(servicer, "w0", "w1")
+    _pull(servicer, "w0", 0, v)
+    _pull(servicer, "w1", 0, v)
+    servicer.Heartbeat({"worker_id": "w0", "version": v, "gang_seq": 1})
+    servicer.Heartbeat({"worker_id": "w1", "version": v, "gang_seq": 2})
+    clock.advance(0.25)
+    # The straggler's OWN beat trips the deadline: the skip fires inside
+    # this very Heartbeat call, and the response must already refuse the
+    # revival (the eviction re-check runs after the skip).
+    resp = servicer.Heartbeat({"worker_id": "w0", "version": v, "gang_seq": 1})
+    assert servicer.JobStatus({})["skipped_ranks"] == {"w0": 1}
+    v_evicted = rv.version()
+    assert resp["version"] == v_evicted and resp["version"] != v
+    assert "w0" not in rv.membership()["workers"]
+    # The wedged rank's beat thread keeps beating: no revival, no version
+    # churn — the response's version mismatch is what drives its restart.
+    for _ in range(3):
+        resp = servicer.Heartbeat(
+            {"worker_id": "w0", "version": v, "gang_seq": 1}
+        )
+        assert resp["version"] == v_evicted and resp["version"] != v
+    assert "w0" not in rv.membership()["workers"]
+    assert rv.version() == v_evicted
+    # Its stale gang_seq stayed out of the deadline accounting: only w1
+    # remains at the boundary, and nobody lags anyone.
+    clock.advance(0.25)
+    assert servicer.maybe_skip_straggler() is None
+    # A stale arrival re-seeded by a beat that lost the check-then-act
+    # race against the eviction (interleaving: first evicted-check passes,
+    # the skip lands, note_gang_progress re-inserts) is dropped by the
+    # next refused beat — left behind, it would fake a SECOND eviction of
+    # the same stall one deadline later, double-charging the skip budget.
+    with servicer._group_lock:
+        servicer._gang_arrivals["w0"] = (1, clock())
+    servicer.Heartbeat({"worker_id": "w0", "version": v, "gang_seq": 1})
+    with servicer._group_lock:
+        assert "w0" not in servicer._gang_arrivals
+    clock.advance(0.25)
+    assert servicer.maybe_skip_straggler() is None
+    assert servicer.JobStatus({})["skipped_ranks"] == {"w0": 1}
+    # Deliberate re-registration (the restart path) lifts the block.
+    v2 = _join(servicer, "w0", "w1")
+    assert "w0" in rv.membership()["workers"]
+    assert servicer.Heartbeat(
+        {"worker_id": "w0", "version": v2, "gang_seq": 0}
+    )["version"] == v2
+
+
 def test_gang_deadline_skips_one_rank_per_window():
     """Three ranks, two stragglers: one eviction per deadline window —
     skips stay attributable one rank at a time, and the second laggard
