@@ -26,6 +26,12 @@ gated, not reviewed, into compliance:
                         ``chaos.hook`` API (``chaos/inject.py``);
                         fire/configure/set_context/parse_plan and direct
                         ChaosInjector construction are findings
+- ``gauge-discipline``  ``# hot-path`` functions update metrics only via
+                        the O(1) counter/gauge/histogram API
+                        (``common/gauge.py`` inc/set/add/observe);
+                        scrape/aggregation calls (snapshot/
+                        render_prometheus/merge_snapshots/...) are
+                        findings
 
 v2 adds the interprocedural layer (``analysis/callgraph.py``: resolved
 self-method and module-function call edges across the repo):
@@ -68,6 +74,7 @@ from elasticdl_tpu.analysis.core import (  # noqa: F401
     run_lint,
     run_lint_full,
 )
+from elasticdl_tpu.analysis.gauge_discipline import GaugeDisciplinePass
 from elasticdl_tpu.analysis.hot_path import HotPathSyncPass
 from elasticdl_tpu.analysis.import_hygiene import ImportHygienePass
 from elasticdl_tpu.analysis.lock_discipline import LockDisciplinePass
@@ -91,4 +98,5 @@ def all_passes() -> list:
         LockOrderPass(),
         TraceDisciplinePass(),
         ChaosDisciplinePass(),
+        GaugeDisciplinePass(),
     ]

@@ -41,6 +41,9 @@ KNOWN_RULES = {
     # r13: hot-path fault-injection crossings use the no-op-when-disabled
     # chaos.hook only (chaos/inject.py); setup/injector API is a finding.
     "chaos-discipline",
+    # r14: hot-path metric updates use the O(1) counter/gauge/histogram
+    # API only (common/gauge.py); scrape/aggregation calls are findings.
+    "gauge-discipline",
     # v2 interprocedural passes (analysis/callgraph.py layer).
     "blocking-propagation",
     "lock-order",

@@ -1,0 +1,85 @@
+"""gauge-discipline: hot-path metric updates use the O(1) ring/counter
+API only.
+
+``common/gauge.py`` splits its surface the way ``common/trace.py`` does
+(trace-discipline is the template):
+
+- ``inc``/``set``/``add``/``observe`` on a metric handle are O(1)
+  leaf-lock updates — legal anywhere, including ``# hot-path``
+  functions; registration (``counter``/``gauge``/``histogram``) is a
+  dict lookup and also fine;
+- everything scrape-side — ``snapshot``/``render_prometheus``/
+  ``scalar_values`` (walk every family and run the registered
+  collectors), ``render_families``/``merge_snapshots``/
+  ``fleet_snapshot`` (the master's aggregation math) — belongs on
+  control-plane boundaries (heartbeats, checkpoint reports, the scrape
+  server's render callable), never inside a ``# hot-path`` function's
+  steady state.
+
+A scrape call inside a hot path would make MEASURING the thing that
+stalls the measured loop — the exact failure mode the one-attribute-
+check-when-disabled design exists to rule out.  This pass keeps the
+split enforced.
+
+Traversal and exemption scope (handlers/nested defs exempt, no phase
+excuse) are the shared ``HotPathCallDisciplinePass`` contract — one body
+with ``trace-discipline``/``chaos-discipline``, so the family cannot
+drift.  The distinctive names (``render_prometheus``, ``render_families``,
+``merge_snapshots``, ``fleet_snapshot``, ``scalar_values``) flag on any
+receiver; ``snapshot`` is a common verb (``PhaseTimers.snapshot``,
+``Trainer.snapshot_state`` are unrelated and hot-path-adjacent), so it is
+matched only on gauge-shaped receivers.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from elasticdl_tpu.analysis.core import (
+    HotPathCallDisciplinePass,
+    receiver_hinted,
+)
+
+#: Scrape/aggregation attribute names that always flag in a hot-path body.
+_SCRAPE_ATTRS = {
+    "render_prometheus",
+    "render_families",
+    "merge_snapshots",
+    "fleet_snapshot",
+    "scalar_values",
+}
+
+#: ``snapshot`` flags only when the receiver chain looks like a metrics
+#: registry (``self.gauges.snapshot()``, ``registry.snapshot()``) — an
+#: unrelated object's snapshot() is never punished.
+_GAUGE_RECEIVER_HINTS = ("gauge", "gauges", "registry", "reg", "fleet")
+
+
+def _is_scrape_call(node: ast.Call) -> bool:
+    f = node.func
+    if not isinstance(f, ast.Attribute):
+        return False
+    if f.attr in _SCRAPE_ATTRS:
+        return True
+    if f.attr == "snapshot":
+        return receiver_hinted(f, _GAUGE_RECEIVER_HINTS)
+    return False
+
+
+class GaugeDisciplinePass(HotPathCallDisciplinePass):
+    name = "gauge-discipline"
+    description = (
+        "functions marked '# hot-path' may update metrics only through "
+        "the O(1) counter/gauge/histogram API (inc/set/add/observe); "
+        "scrape/aggregation calls (snapshot/render_prometheus/"
+        "render_families/merge_snapshots/fleet_snapshot/scalar_values) "
+        "are findings"
+    )
+    message = (
+        "gauge scrape/aggregation inside a '# hot-path' function — "
+        "serve snapshots from a control-plane boundary (heartbeat/"
+        "report/scrape server) instead, or waive with a reason"
+    )
+
+    def is_flagged_call(self, node: ast.Call) -> bool:
+        return _is_scrape_call(node)

@@ -219,6 +219,20 @@ class JobConfig:
     # Ring capacity (events) of the per-process trace buffer; oldest events
     # are overwritten, so the buffer always holds the most recent window.
     trace_buffer_events: int = 65536
+    # graftgauge (r14, common/gauge.py + common/metrics_http.py): every
+    # process of the job — master, workers, PS shards — serves a live
+    # Prometheus-text /metrics (+ /healthz JSON) scrape endpoint when
+    # this is >= 0.  0 = bind an ephemeral port (the only collision-safe
+    # choice on a shared config bus: two workers on one host cannot
+    # share a fixed port) — each process logs its bound address as a
+    # "[graftgauge] serving /metrics on ..." pod-log line, the same
+    # discovery channel the chaos bench uses for its audit lines.  > 0 =
+    # bind exactly that port (single-process-per-host deployments).
+    # -1 (default) = no endpoint; the registry still records (its cost
+    # is the point: one leaf-lock add per update, measured on the ingest
+    # A/B harness — docs/observability.md), so flipping the endpoint on
+    # is purely additive.
+    gauge_port: int = -1
     profile_dir: str = ""  # worker: jax.profiler trace of one training task
     metrics_dir: str = ""  # master: JSONL + TensorBoard scalar stream
     # Process backend: capture each worker pod's stdout+stderr to
@@ -333,6 +347,10 @@ class JobConfig:
             raise ValueError("--optimizer_sharding_auto_mb must be positive")
         if self.trace_buffer_events < 1:
             raise ValueError("--trace_buffer_events must be >= 1")
+        if self.gauge_port < -1:
+            raise ValueError(
+                "--gauge_port must be -1 (off), 0 (ephemeral) or a port"
+            )
         if self.chaos:
             # Parse-validate HERE (jax-free, stdlib): a typo'd fault plan
             # must fail the job submission, not silently never fire and

@@ -122,11 +122,20 @@ class PhaseTimers:
     — a background phase never subtracts from a foreground one.
     """
 
-    def __init__(self):
+    def __init__(self, gauges=None):
         self._lock = locksan.lock("PhaseTimers._lock", leaf=True)  # lock-order: leaf
         self._seconds: Dict[str, float] = {}
         self._counts: Dict[str, int] = {}
         self._local = threading.local()
+        # graftgauge (r14): with a registry wired, every phase ENTRY also
+        # observes into a per-phase duration histogram (shared log grid),
+        # so a live scrape shows the phase tail SHAPE — the cumulative
+        # seconds alone cannot tell "one 2 s stall" from "2000 stalls of
+        # 1 ms".  Histogram handles are cached per phase name: the add()
+        # path pays one dict lookup + an O(1) observe, not a registry
+        # walk.
+        self._gauges = gauges
+        self._phase_hists: Dict[str, object] = {}  # guarded-by: _lock
 
     @contextlib.contextmanager
     def phase(self, name: str):
@@ -159,6 +168,22 @@ class PhaseTimers:
         with self._lock:
             self._seconds[name] = self._seconds.get(name, 0.0) + seconds
             self._counts[name] = self._counts.get(name, 0) + 1
+            hist = self._phase_hists.get(name)
+        if self._gauges is None:
+            return
+        if hist is None:
+            # Created OUTSIDE our leaf lock (the registry lookup takes
+            # the registry's own leaf; nesting the two would break both
+            # declarations).  Registry.histogram is idempotent, so a
+            # racing creation converges on the same series.
+            hist = self._gauges.histogram(
+                "edl_phase_ms",
+                "per-entry wall of each task-loop phase (self-time)",
+                labels={"phase": name},
+            )
+            with self._lock:
+                self._phase_hists[name] = hist
+        hist.observe(seconds * 1e3)
 
     def snapshot(self) -> Dict[str, float]:
         """Cumulative seconds per phase (plain floats — JSON/RPC-safe)."""

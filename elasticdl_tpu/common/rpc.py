@@ -166,6 +166,15 @@ for _method_schema in MASTER_SCHEMAS.values():
     _method_schema.optional.setdefault("trace", _DICT)
 for _method in ("ReportTaskResult", "Heartbeat", "ReportCheckpoint"):
     MASTER_SCHEMAS[_method].optional.setdefault("phase_counts", _DICT)
+# gauge (r14): the live-metrics envelope — a worker/PS process's
+# ``gauge.Registry.snapshot()`` ({"families": {...}}) riding the same
+# heartbeat/report channel as the trace slices, so the master's /metrics
+# endpoint can serve the FLEET view (aggregated examples/sec, per-rank
+# gang lag, goodput) without a new RPC.  Additive and optional on the
+# same three methods as phase_counts — no PROTOCOL_VERSION bump (the
+# r9/r12 stance: old peers ignore the field in either direction).
+for _method in ("ReportTaskResult", "Heartbeat", "ReportCheckpoint"):
+    MASTER_SCHEMAS[_method].optional.setdefault("gauge", _DICT)
 
 
 SERVING_SERVICE_NAME = "elasticdl.Serving"

@@ -230,6 +230,37 @@ class Master:
         # empty and pays a cold relaunch.
         self.servicer.set_standby_depth(self.pod_manager.standby_depth)
 
+        # graftgauge (r14): the master's live /metrics endpoint serves the
+        # fleet-aggregated view + goodput/SLO computer (servicer.fleet,
+        # master/fleet_metrics.py) — workers ship their registry snapshots
+        # on the heartbeat/report gauge envelope, this endpoint is where an
+        # operator (or tools/watch_job.py) reads them DURING the job.  The
+        # PodManager's fleet-churn scalars join as a collector, so the pod
+        # plane is visible on the same page (stdlib HTTP: the control
+        # plane stays jax-free).
+        from elasticdl_tpu.common.metrics_http import maybe_start
+
+        self.servicer.fleet.registry.add_collector(self._collect_pod_gauges)
+        self.metrics_server = maybe_start(
+            config.gauge_port,
+            self.servicer.fleet.render,
+            health_fn=self.servicer.fleet.health,
+        )
+
+    def _collect_pod_gauges(self) -> None:
+        """Scrape-time collector: PodManager fleet churn (worker + PS
+        fleets) into the master registry."""
+        reg = self.servicer.fleet.registry
+        for prefix, mgr in (("worker", self.pod_manager), ("ps", self.ps_manager)):
+            if mgr is None:
+                continue
+            for key, v in mgr.counts().items():
+                reg.gauge(
+                    f"edl_pods_{key}",
+                    "pod-fleet state (PodManager.counts)",
+                    labels={"fleet": prefix},
+                ).set(float(v))
+
     def _load_progress(self, num_shards: int, num_epochs: int):
         if not self._progress_path or not os.path.exists(self._progress_path):
             return None
@@ -414,6 +445,8 @@ class Master:
             self.shutdown()
 
     def shutdown(self) -> None:
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
         self.pod_manager.stop()
         if self.ps_manager is not None:
             # After workers: their final checkpoint fans a Save out to the

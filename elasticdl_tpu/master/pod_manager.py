@@ -915,6 +915,20 @@ class PodManager:
         fn = getattr(self._backend, "standby_depth", None)
         return fn() if fn is not None else None
 
+    def counts(self) -> Dict[str, int]:
+        """Fleet-state scalars for the live metrics plane (the master's
+        /metrics collector, master/main.py): desired slots, live pods, and
+        the summed relaunch generations — churn made a readable number."""
+        with self._lock:
+            infos = [i for i in self._slots.values() if i is not None]
+            return {
+                "desired": self._desired,
+                "live": sum(
+                    1 for i in infos if i.phase not in PodPhase.TERMINAL
+                ),
+                "relaunches": sum(i.relaunches for i in infos),
+            }
+
     def all_finished(self) -> bool:
         """True when every slot's pod has reached a terminal phase."""
         with self._lock:

@@ -30,7 +30,11 @@ Every method additionally accepts the optional ``trace`` envelope
 (common/rpc.py): span context from the caller, and — on Heartbeat/Report
 methods — bounded slices of the worker's trace ring buffer, which the
 master accumulates per worker for DumpTrace (the live-job introspection
-pull that tools/trace_dump.py merges into one Chrome trace).
+pull that tools/trace_dump.py merges into one Chrome trace).  Since r14
+the same three methods carry the optional ``gauge`` envelope (a worker's
+live-metrics registry snapshot); the master banks them per worker and
+its /metrics endpoint serves the fleet-aggregated view plus the derived
+goodput/SLO gauges (master/fleet_metrics.py, docs/observability.md).
 """
 
 from __future__ import annotations
@@ -169,6 +173,17 @@ class MasterServicer:
         # PodManager's depth here; Heartbeat/JobStatus republish it so a
         # DRAINED pool is visible before the next failure needs it.
         self._standby_depth_fn = None  # guarded-by: _lock
+        # graftgauge (r14): the fleet metrics plane.  Workers ship their
+        # registry snapshot on the same heartbeat/report channel as the
+        # trace slices (the additive ``gauge`` envelope); FleetMetrics
+        # banks them and computes the aggregated view + goodput/SLO
+        # gauges at SCRAPE time — the master's /metrics endpoint
+        # (master/main.py) serves fleet.render().  Constructed here
+        # unconditionally (stdlib, a dict bank: negligible without an
+        # endpoint) so in-process tests and every master share one path.
+        from elasticdl_tpu.master.fleet_metrics import FleetMetrics
+
+        self.fleet = FleetMetrics(self)
 
     # -- rendezvous listener: requeue tasks of evicted workers --
 
@@ -478,6 +493,10 @@ class MasterServicer:
         task_type = req.get("task_type", "")
         self._record_phase_times(req)
         self._record_trace(req)
+        # stream=True: one JSONL "gauge" record per successful training
+        # report, beside the "phase" record — the same crash-safe channel
+        # and cadence.
+        self._record_gauges(req, stream=True)
         if task_type == TASK_EVALUATION and self.evaluation is not None:
             # Metrics BEFORE report_task: completing the round's last task
             # snapshots the aggregate.
@@ -635,6 +654,76 @@ class MasterServicer:
             "master_now_us": trace.now_us(),
         }
 
+    # hot-path: rides every report and heartbeat — a dict-bank assignment
+    # plus one rate-window append, never an aggregation walk (that is
+    # scrape-side work, the gauge-discipline split)
+    def _record_gauges(self, req: dict, stream: bool = False) -> None:
+        """Bank a Heartbeat/Report-borne gauge envelope into the fleet
+        view.  ``stream=True`` (checkpoint reports — bounded frequency,
+        the phase-mirror stance inverted: heartbeats arrive every poll
+        interval and would flood the JSONL) also mirrors the envelope's
+        ``JSONL_GAUGE_FAMILIES`` scalars into the metrics stream under
+        the SAME family names the live scrape serves — the one naming
+        table, so offline JSONL analysis and live scrapes cannot
+        drift."""
+        payload = req.get("gauge")
+        if not isinstance(payload, dict):
+            return
+        worker_id = req.get("worker_id", "")
+        if not worker_id:
+            return  # unattributable families cannot join a per-worker view
+        self.fleet.record_envelope(worker_id, payload)
+        if stream and (
+            not req.get("success", True)
+            or req.get("task_type", "") == TASK_EVALUATION
+        ):
+            stream = False  # the phase-mirror gating, same reasons
+        if stream and self.metrics_writer is not None:
+            mirror = self.fleet.jsonl_mirror(worker_id, payload)
+            if mirror:
+                with self._lock:
+                    version = self._model_version
+                try:
+                    self.metrics_writer.write("gauge", version, mirror)
+                except Exception:  # malformed values must not fail the RPC
+                    logger.exception("gauge metrics write failed")
+
+    def gang_lag_snapshot(self) -> Dict[str, float]:
+        """Per-rank seconds behind the gang head's lockstep arrival
+        (r13's deadline signal, read live for the metrics plane).  Ranks
+        at the head read 0.0; a trailing rank reads ``now - head_t`` —
+        the exact clock ``maybe_skip_straggler`` judges against (time
+        since the head arrived with this rank still absent), NOT time
+        since the rank's own previous arrival, which would overstate lag
+        by a full step even on a healthy gang.  Empty outside group
+        mode."""
+        with self._group_lock:
+            head_seq, head_t = self._gang_head
+            if self._group_version is None or head_t is None:
+                return {}
+            now = self._clock()
+            return {
+                w: (round(max(now - head_t, 0.0), 3) if seq < head_seq
+                    else 0.0)
+                for w, (seq, _t) in self._gang_arrivals.items()
+            }
+
+    def fleet_state_snapshot(self) -> dict:
+        """The master-side state the fleet collector aggregates, read
+        under the state lock in one place (FleetMetrics must not grope
+        guarded attributes cross-class)."""
+        with self._lock:
+            state = {
+                "model_version": self._model_version,
+                "skipped_ranks": dict(self._skipped_ranks),
+                "phase_times": {
+                    w: dict(p) for w, p in self._phase_times.items()
+                },
+            }
+            depth_fn = self._standby_depth_fn
+        state["standby_depth"] = depth_fn() if depth_fn is not None else None
+        return state
+
     def _maybe_write_eval_metrics(self) -> None:
         """Record each completed eval round's aggregate exactly once.  The
         check-and-set runs under the lock: ReportTaskResult handlers run on
@@ -744,6 +833,11 @@ class MasterServicer:
         self._record_phase_times(req, stream=False)
         # Trace slices ride the heartbeat (the pull path's supply side).
         self._record_trace(req)
+        # Gauge envelopes too (r14): the beat is the one RPC still
+        # flowing from a wedged gang, so the fleet view stays live
+        # exactly when the operator needs it.  Bank-only — the JSONL
+        # mirror rides checkpoint reports (bounded frequency).
+        self._record_gauges(req)
         # Gang-deadline watchdog (r13): heartbeats are the only RPCs still
         # arriving when the whole gang is wedged in a collective on a
         # straggler — the beat both FEEDS the per-rank progress signal
@@ -852,6 +946,7 @@ class MasterServicer:
     def ReportCheckpoint(self, req: dict) -> dict:
         self._record_phase_times(req)
         self._record_trace(req)
+        self._record_gauges(req, stream=True)
         with self._lock:
             if int(req["step"]) >= int(self._checkpoint["step"] or 0):
                 self._checkpoint = {"path": req["path"], "step": int(req["step"])}

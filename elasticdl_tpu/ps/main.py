@@ -81,6 +81,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     if config.checkpoint_dir:
         server.restore_latest(config.checkpoint_dir)
 
+    # graftgauge (r14): the shard's live /metrics endpoint — pull/push
+    # rates, latency histograms and per-table row counts (PSServer records
+    # into the process-default registry).  Daemon threads of their own: a
+    # shard wedged in a Save must still answer the scrape.
+    from elasticdl_tpu.common.metrics_http import maybe_start
+
+    metrics_server = maybe_start(
+        config.gauge_port,
+        server.gauges.render_prometheus,
+        health_fn=lambda: {
+            "role": "ps",
+            "shard": slot,
+            "num_shards": num_shards,
+        },
+    )
+
     stop = threading.Event()
 
     def _terminate(signum, frame):
@@ -96,6 +112,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             stop.wait(1.0)
     finally:
         server.stop(grace=5.0)
+        if metrics_server is not None:
+            metrics_server.stop()
     return 0
 
 

@@ -59,6 +59,7 @@ import grpc
 import numpy as np
 
 from elasticdl_tpu import chaos
+from elasticdl_tpu.common import gauge as gaugelib
 from elasticdl_tpu.common import locksan, trace
 from elasticdl_tpu.common.log_utils import get_logger
 
@@ -246,6 +247,7 @@ class PSServer:
         num_shards: int = 1,
         port: int = 0,
         max_workers: int = 16,
+        gauges: Optional[gaugelib.Registry] = None,
     ):
         from elasticdl_tpu.ps.host_store import HostEmbeddingStore
 
@@ -276,6 +278,32 @@ class PSServer:
         # leaf lock makes the hand-off explicit (graftlint lock-discipline).
         self._meta_lock = locksan.lock("PSServer._meta_lock", leaf=True)  # lock-order: leaf
         self.restored_step: Optional[int] = None  # guarded-by: _meta_lock
+        # graftgauge (r14): pull/push rates + latency tails, live.  The
+        # shard's own registry defaults to the process-default one so the
+        # PS pod's /metrics endpoint (ps/main.py) serves everything the
+        # process records; in-process fleets (tests, serving_bench) pass
+        # their own instance to keep shards' families apart.  Updates are
+        # O(1) counter/histogram ops — legal in the # hot-path handlers
+        # (gauge-discipline); table row counts are a scrape-time collector.
+        self.gauges = gauges if gauges is not None else gaugelib.default()
+        shard_label = {"shard": str(shard)}
+        self._g_pulls = self.gauges.counter(
+            "edl_ps_pull_total", "Pull RPCs served by this shard",
+            labels=shard_label,
+        )
+        self._g_pull_ms = self.gauges.histogram(
+            "edl_ps_pull_ms", "server-side Pull wall per RPC",
+            labels=shard_label,
+        )
+        self._g_pushes = self.gauges.counter(
+            "edl_ps_push_total", "PushGrad RPCs served by this shard",
+            labels=shard_label,
+        )
+        self._g_push_ms = self.gauges.histogram(
+            "edl_ps_push_ms", "server-side PushGrad wall per RPC",
+            labels=shard_label,
+        )
+        self.gauges.add_collector(self._collect_gauges)
         # Message-size limits must cover production batches: a full 8192x26
         # dim-8 push is ~8.5 MB of frame, over gRPC's 4 MB default — the
         # server AND the client (PSClient) both raise the cap, or a
@@ -336,6 +364,7 @@ class PSServer:
         # Span via the non-blocking ring API only (trace-discipline): the
         # PS read is the serving/training tiers' shared tail-latency
         # suspect, so its server-side wall is first-class trace data.
+        t0 = time.perf_counter()
         with trace.span(
             "ps:pull", cat="ps.server", table=meta["table"], n_ids=int(ids.size)
         ):
@@ -346,6 +375,10 @@ class PSServer:
                 # New ids materialize rows (mutation): exclusive per-table.
                 with lock.write():
                     rows = store.pull(ids)
+        # graftgauge: O(1) counter/histogram updates (gauge-discipline) —
+        # the live twin of the ps:pull span's wall.
+        self._g_pulls.inc()
+        self._g_pull_ms.observe((time.perf_counter() - t0) * 1e3)
         return {}, {"rows": rows}
 
     # hot-path: the per-step gradient apply
@@ -358,12 +391,15 @@ class PSServer:
                 f"grads shape {grads.shape} != ids {ids.shape} + (dim "
                 f"{store.dim},)"
             )
+        t0 = time.perf_counter()
         with trace.span(
             "ps:push_grad", cat="ps.server", table=meta["table"],
             n_ids=int(ids.size),
         ):
             with self._locks[meta["table"]].write():
                 store.push_grad(ids, grads)
+        self._g_pushes.inc()
+        self._g_push_ms.observe((time.perf_counter() - t0) * 1e3)
         return {"applied": int(ids.size)}, {}
 
     @contextlib.contextmanager
@@ -439,6 +475,24 @@ class PSServer:
             self.restored_step = int(meta["step"])
         return {"loaded": True}, {}
 
+    def _collect_gauges(self) -> None:
+        """Scrape-time collector (never the hot handlers — the
+        gauge-discipline split): per-table row counts and the restored-step
+        marker, refreshed per scrape."""
+        for key, s in self._stores.items():
+            self.gauges.gauge(
+                "edl_ps_rows", "materialized rows per table on this shard",
+                labels={"shard": str(self.shard), "table": key},
+            ).set(float(len(s)))
+        with self._meta_lock:
+            restored = self.restored_step
+        if restored is not None:
+            self.gauges.gauge(
+                "edl_ps_restored_step",
+                "step this shard restored at (re)start",
+                labels={"shard": str(self.shard)},
+            ).set(float(restored))
+
     def _stats(self, meta, arrays):
         with self._meta_lock:
             restored = self.restored_step
@@ -502,6 +556,10 @@ class PSServer:
 
     def stop(self, grace: float = 1.0) -> None:
         self._server.stop(grace)
+        # Unhook from the (possibly process-shared) registry — a stopped
+        # shard's collector must not keep re-publishing its frozen row
+        # counts or pin the shard's stores in memory.
+        self.gauges.remove_collector(self._collect_gauges)
 
     def restore_latest(self, checkpoint_dir: str) -> Optional[int]:
         """Startup restore for a (re)launched PS pod: load this shard's slice
@@ -613,6 +671,16 @@ class RemoteEmbeddingStore:
         self.dim = dim
         self._clients = [PSClient(a) for a in addresses]
         self.num_shards = len(self._clients)
+        # Client-side retry visibility (r14): the counter records into the
+        # PROCESS-default registry — the store is constructed deep inside
+        # the trainer, and the worker/serving process wires its registry as
+        # the default at startup, so the one scrape endpoint shows retries
+        # beside everything else the process measures.
+        self._g_retries = gaugelib.default().counter(
+            "edl_ps_retry_total",
+            "client-side transient-outage retries against the PS fleet",
+            labels={"table": table},
+        )
 
     def _retry(self, fn):
         """Run ``fn()``, retrying transient shard outages (UNAVAILABLE — the
@@ -631,6 +699,7 @@ class RemoteEmbeddingStore:
                     "ps:retry", cat="ps.client", table=self.table,
                     attempt=i + 1, code=str(e.code()),
                 )
+                self._g_retries.inc()
                 logger.warning(
                     "PS call failed (%s), retry %d/%d in %.0fs",
                     e.code(), i + 1, len(self.RETRY_BACKOFFS_S), backoff,

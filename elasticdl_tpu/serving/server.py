@@ -43,6 +43,7 @@ from typing import Any, Dict, Optional, Tuple
 import grpc
 import numpy as np
 
+from elasticdl_tpu.common import gauge as gaugelib
 from elasticdl_tpu.common import locksan
 from elasticdl_tpu.common.config import DistributionStrategy, JobConfig
 from elasticdl_tpu.common.log_utils import get_logger
@@ -104,6 +105,9 @@ class ServingServer:
         port: int = 0,
         max_workers: int = 16,
         seed: int = 0,
+        gauges: Optional[gaugelib.Registry] = None,
+        gauge_port: int = -1,
+        target_p99_ms: float = 100.0,
     ):
         import jax
 
@@ -212,6 +216,27 @@ class ServingServer:
             name=spec.name,
         )
 
+        # graftgauge (r14): the replica's live metrics — request counter +
+        # per-request latency histogram updated on the # hot-path handler
+        # (O(1): gauge-discipline), everything else (batcher fill/shed,
+        # cache hit rate, reload counter, the p99-vs-target SLO ratio)
+        # collected from the existing stats() surfaces at scrape time.
+        # ``target_p99_ms`` is the operator's SLO line: the endpoint serves
+        # the live p99/target ratio so a blowout reads as a number > 1.0.
+        self.target_p99_ms = float(target_p99_ms)
+        self.gauges = gauges if gauges is not None else gaugelib.default()
+        self._g_requests = self.gauges.counter(
+            "edl_serving_requests_total", "Predict requests answered"
+        )
+        self._g_request_ms = self.gauges.histogram(
+            "edl_serving_request_ms",
+            "per-request wall inside the Predict handler (parse + queue + "
+            "flush + fan-back)",
+        )
+        self.gauges.add_collector(self._collect_gauges)
+        self._gauge_port = gauge_port
+        self._metrics_server = None
+
         self._server = grpc.server(futures.ThreadPoolExecutor(max_workers))
         self._server.add_generic_rpc_handlers(
             (
@@ -317,11 +342,14 @@ class ServingServer:
     # hot-path: the per-request gRPC handler — parse, enqueue, park on the
     # flush fan-back; never a device touch (the flusher owns the forward)
     def _predict(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        t0 = time.perf_counter()
         features = self._parse_features(req["features"])
         handle = self._batcher.submit(features)
         outputs, meta = handle.result(timeout_s=30.0)
         with self._state_lock:
             self._requests += 1
+        self._g_requests.inc()
+        self._g_request_ms.observe((time.perf_counter() - t0) * 1e3)
         return {
             "outputs": _listify(outputs),
             "model": self.spec.name,
@@ -339,6 +367,59 @@ class ServingServer:
 
         out = self.trainer.run_predict_step(live.state, batch)
         return jax.device_get(out), {"step": live.step}
+
+    def _collect_gauges(self) -> None:
+        """Scrape-time collector (gauge-discipline: never the request
+        path): batcher/cache/reload state re-published from the stats()
+        surfaces, plus the goodput/SLO gauges — live p99 estimated from
+        the request histogram on the shared bucket grid, served beside the
+        operator's target as a ratio (> 1.0 = the SLO is blown NOW)."""
+        g = self.gauges
+        stats = self._batcher.stats()
+        g.gauge("edl_serving_queue_depth", "requests parked in the "
+                "micro-batcher").set(float(stats["queued"]))
+        g.gauge("edl_serving_shed_overload", "requests shed at the "
+                "queue-row bound").set(float(stats["shed_overload"]))
+        g.gauge("edl_serving_expired", "requests expired at flush time"
+                ).set(float(stats["expired"]))
+        served = stats["rows_served"]
+        g.gauge(
+            "edl_serving_batch_fill_ratio",
+            "real rows / flushed rows (padding waste is 1 - this)",
+        ).set(served / (served + stats["rows_padded"])
+              if served + stats["rows_padded"] else 0.0)
+        for key, cache in self._caches.items():
+            cs = cache.stats()
+            hits, misses = cs["hits"], cs["misses"]
+            g.gauge(
+                "edl_serving_cache_hit_ratio",
+                "hot-id embedding cache hit rate",
+                labels={"table": key},
+            ).set(hits / (hits + misses) if hits + misses else 0.0)
+            g.gauge(
+                "edl_serving_cache_rows", "cached rows",
+                labels={"table": key},
+            ).set(float(cs["size"]))
+        with self._state_lock:
+            step, reloads = self._live.step, self._reloads
+        g.gauge("edl_serving_step", "live model step").set(float(step))
+        g.gauge("edl_serving_reloads", "hot reloads performed").set(
+            float(reloads)
+        )
+        p99 = self._g_request_ms.quantile(0.99)
+        if p99 is not None:
+            g.gauge(
+                "edl_serving_p99_ms",
+                "live request p99 (bucket-grid estimate)",
+            ).set(p99)
+            g.gauge(
+                "edl_serving_p99_target_ms", "operator SLO target"
+            ).set(self.target_p99_ms)
+            g.gauge(
+                "edl_serving_slo_ratio",
+                "live p99 over the target — > 1.0 means the SLO is "
+                "blown right now",
+            ).set(p99 / self.target_p99_ms if self.target_p99_ms else 0.0)
 
     def _model_info(self, req: Dict[str, Any]) -> Dict[str, Any]:
         with self._state_lock:
@@ -370,10 +451,29 @@ class ServingServer:
     def address(self) -> str:
         return f"localhost:{self.port}"
 
+    @property
+    def metrics_address(self) -> Optional[str]:
+        """host:port of the live /metrics endpoint (after start(); None
+        when gauge_port < 0 or the bind failed)."""
+        return (
+            self._metrics_server.address
+            if self._metrics_server is not None else None
+        )
+
     def start(self) -> "ServingServer":
         self._server.start()
         if self._watcher is not None:
             self._watcher.start()
+        # The scrape endpoint runs its own daemon threads — a replica
+        # wedged past its knee must still answer /metrics (the whole
+        # point of serving the SLO ratio live).
+        from elasticdl_tpu.common.metrics_http import maybe_start
+
+        self._metrics_server = maybe_start(
+            self._gauge_port,
+            self.gauges.render_prometheus,
+            health_fn=lambda: {"role": "serving", "model": self.spec.name},
+        )
         logger.info(
             "serving %s on port %d (max_batch %d, deadline %.1fms)",
             self.spec.name, self.port, self.max_batch, self.max_delay_ms,
@@ -384,6 +484,13 @@ class ServingServer:
         self._server.wait_for_termination()
 
     def stop(self, grace: float = 1.0) -> None:
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+            self._metrics_server = None
+        # Unhook from the (possibly process-shared) registry: a stopped
+        # replica must neither keep publishing its frozen stats nor be
+        # pinned in memory by the registry's collector reference.
+        self.gauges.remove_collector(self._collect_gauges)
         if self._watcher is not None:
             self._watcher.stop()
         # grpc's stop() is non-blocking (it returns an Event); WAIT the

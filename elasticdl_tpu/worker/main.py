@@ -318,6 +318,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                     # never tell the straggler (arrival counter frozen)
                     # from the ranks blocked on it (counter one ahead).
                     hb.update(w.gang_beat_fields())
+                    # Gauge envelope (r14) on the SAME beat, for the same
+                    # reason: a wedged gang's fleet metrics must keep
+                    # flowing while the task loop's own heartbeat is
+                    # silent (registry locks are leaves — safe from this
+                    # thread).
+                    gp = w.gauge_payload()
+                    if gp is not None:
+                        hb["gauge"] = gp
                 resp = master.call("Heartbeat", hb)
                 master_version = resp.get("version")
             except Exception:  # master briefly unreachable: retry next beat
@@ -352,10 +360,27 @@ def main(argv: Optional[List[str]] = None) -> int:
             heartbeat_timeout_s=config.distributed_heartbeat_timeout_s,
         )
         distributed.initialize(spec)
+    # The process-default registry (r14): the worker's own families plus
+    # cross-cutting client-side ones (the PS retry counter records via
+    # gauge.default()) all land in ONE registry, so the scrape endpoint
+    # below serves everything this process measures.
+    from elasticdl_tpu.common import gauge
+    from elasticdl_tpu.common.metrics_http import maybe_start
+
     worker = Worker(
-        config, master, build_job_reader(config), worker_id=worker_id
+        config, master, build_job_reader(config), worker_id=worker_id,
+        gauges=gauge.default(),
     )
     worker_holder["worker"] = worker
+    metrics_server = maybe_start(
+        config.gauge_port,
+        worker.gauges.render_prometheus,
+        health_fn=lambda: {
+            "role": "worker",
+            "worker_id": worker_id,
+            "membership_version": worker._membership_version,
+        },
+    )
     try:
         result = worker.run(membership=membership)
     except WorkerRestartRequired as e:
@@ -370,6 +395,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         os._exit(RESTART_EXIT_CODE)
     finally:
         hb_stop.set()
+        if metrics_server is not None:
+            metrics_server.stop()
     logger.info("worker %s finished: %s", worker_id, result)
     return 0
 

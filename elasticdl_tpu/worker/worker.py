@@ -25,6 +25,7 @@ import jax
 import numpy as np
 
 from elasticdl_tpu import chaos
+from elasticdl_tpu.common import gauge as gaugelib
 from elasticdl_tpu.common import locksan, trace
 from elasticdl_tpu.common.checkpoint import CheckpointManager
 from elasticdl_tpu.common.config import JobConfig
@@ -142,6 +143,7 @@ class Worker:
         devices: Optional[Sequence[jax.Device]] = None,
         devices_per_worker: int = 0,
         poll_interval_s: float = 0.05,
+        gauges: Optional[gaugelib.Registry] = None,
     ):
         self.config = config
         self.master = master
@@ -238,11 +240,43 @@ class Worker:
         # Background periodic-checkpoint machinery (_save_snapshot_background
         # / _save_group_snapshot_background)
         self._ckpt_thread = None  # guarded-by: _ckpt_lock
+        # graftgauge (r14): the live metrics registry this worker updates
+        # from its hot path — counters for examples/steps/tasks, depth
+        # gauges and the per-phase families collected at scrape time.
+        # An INSTANCE per worker (in-process test fleets run several
+        # workers in one process and each must keep its own families);
+        # worker.main passes the process-default registry so the one
+        # scrape endpoint also serves cross-cutting client-side families
+        # (the PS retry counter).  Snapshots ride the Heartbeat/Report
+        # ``gauge`` envelope (gauge_payload) so the master's endpoint can
+        # aggregate the fleet.
+        self.gauges = gauges if gauges is not None else gaugelib.Registry()
+        self._g_examples = self.gauges.counter(
+            gaugelib.EXAMPLES_TRAINED, "examples trained (records dispatched)"
+        )
+        self._g_steps = self.gauges.counter(
+            gaugelib.STEPS_DISPATCHED, "device steps dispatched"
+        )
+        self._g_tasks = self.gauges.counter(
+            gaugelib.TASKS_DONE, "training/eval/predict tasks completed"
+        )
+        self.gauges.add_collector(self._collect_gauges)
+        # Envelope throttle: the loop heartbeat fires every task-loop
+        # iteration (up to 1/poll_interval per second), and a full
+        # registry snapshot per beat would be the dominant new
+        # per-iteration cost — the fleet view needs ~1 Hz freshness, the
+        # same cadence an external scraper would poll at.  Reports
+        # (bounded frequency) bypass the throttle so the JSONL mirror
+        # never starves.  Benign race between the loop beat and the
+        # background liveness beat: worst case one extra snapshot.
+        self._gauge_ship_interval_s = 1.0
+        self._last_gauge_ship = 0.0
         # Per-phase wall decomposition of the task loop (common/metrics.py
         # PhaseTimers); snapshots ride every report so the master and the
         # train-job artifact can attribute the job-vs-bench gap to named
-        # phases.
-        self.phases = PhaseTimers()
+        # phases.  The registry hook adds a per-entry duration histogram
+        # per phase (edl_phase_ms) to the live scrape.
+        self.phases = PhaseTimers(gauges=self.gauges)
         # grafttrace: --trace turns the per-process span recorder on (every
         # phase above doubles as a span; RPC boundaries, gang waits and
         # elastic transitions add their own).  Bounded slices ship to the
@@ -569,6 +603,65 @@ class Worker:
             "version": self._membership_version,
         }
 
+    def _collect_gauges(self) -> None:
+        """Scrape-time collector (never the task loop): pull-model
+        families that are cheap to READ — depths are GIL-safe ``len``s,
+        the phase families re-publish ``PhaseTimers`` cumulative state —
+        refreshed per scrape/snapshot instead of being pushed per
+        update."""
+        g = self.gauges
+        g.gauge("edl_membership_version", "applied membership version").set(
+            float(self._membership_version)
+        )
+        g.gauge("edl_rank", "rank in the current membership").set(
+            float(self._rank)
+        )
+        g.gauge("edl_reforms_total", "elastic mesh re-formations").set(
+            float(self.reforms)
+        )
+        g.gauge(
+            gaugelib.LEASE_DEPTH, "locally buffered task leases"
+        ).set(float(len(self._leased)))
+        g.gauge(
+            gaugelib.PREP_QUEUE_DEPTH, "prep-ahead tasks in flight"
+        ).set(float(len(self._prep_queue)))
+        if self._group_mode:
+            g.gauge(
+                "edl_gang_dispatched",
+                "gang-boundary arrivals (lockstep entries begun)",
+            ).set(float(self._gang_dispatched))
+        for name, secs in self.phases.snapshot().items():
+            g.gauge(
+                "edl_phase_seconds_total",
+                "cumulative seconds per task-loop phase",
+                labels={"phase": name},
+            ).set(secs)
+        for name, n in self.phases.counts().items():
+            g.gauge(
+                "edl_phase_entries_total",
+                "entries per task-loop phase",
+                labels={"phase": name},
+            ).set(float(n))
+
+    def gauge_payload(self, force: bool = False) -> Optional[dict]:
+        """The Heartbeat/Report ``gauge`` envelope: this worker's full
+        registry snapshot (collectors run, so depths and phase families
+        are fresh).  None when the registry is disabled, or — unless
+        ``force`` — when one shipped within the last
+        ``_gauge_ship_interval_s`` (the loop heartbeat fires every
+        iteration; the fleet view needs ~1 Hz).  Called from
+        control-plane boundaries only — the heartbeat in
+        ``_check_membership``, the background liveness beat, checkpoint
+        reports (forced: the JSONL mirror rides them) — never a
+        ``# hot-path`` function (gauge-discipline)."""
+        if not self.gauges.enabled:
+            return None
+        now = time.monotonic()
+        if not force and now - self._last_gauge_ship < self._gauge_ship_interval_s:
+            return None
+        self._last_gauge_ship = now
+        return {"families": self.gauges.snapshot()}
+
     def _trace_payload(self) -> Optional[dict]:
         """One bounded slice of this process's trace ring for the
         heartbeat/report channel, with the latest clock-offset estimate —
@@ -611,6 +704,9 @@ class Worker:
         tp = self._trace_payload()
         if tp is not None:
             hb["trace"] = tp
+        gp = self.gauge_payload()
+        if gp is not None:
+            hb["gauge"] = gp
         t0_us = trace.now_us()
         resp = self.master.call("Heartbeat", hb)
         t1_us = trace.now_us()
@@ -720,6 +816,11 @@ class Worker:
         tp = self._trace_payload()
         if tp is not None:
             report["trace"] = tp
+        # Forced past the ship throttle: checkpoint reports are the JSONL
+        # gauge mirror's carrier (bounded frequency by construction).
+        gp = self.gauge_payload(force=True)
+        if gp is not None:
+            report["gauge"] = gp
         return report
 
     def _join_ckpt(self, timeout: float = None) -> None:
@@ -1218,6 +1319,10 @@ class Worker:
                 self._recover_state()
             self._steps_dispatched = int(self.state.step)
             raise
+        # Live throughput counters (r14): O(1) adds under a leaf lock —
+        # the only gauge API legal on the hot path (gauge-discipline).
+        self._g_examples.inc(total)
+        self._g_steps.inc(n_steps)
         # Start the D2H copy of the task's metrics NOW, in the background:
         # the runtime moves each value to the host as soon as its step
         # completes, so the deferred fetch in _finalize_training_metrics
@@ -1380,6 +1485,12 @@ class Worker:
         computable downstream, not just cumulative sums."""
         report["phase_times"] = self.phases.snapshot()
         report["phase_counts"] = self.phases.counts()
+        # Gauge envelope on every task report (forced past the ship
+        # throttle: reports are bounded frequency by construction) — the
+        # carrier of the master's per-report JSONL gauge mirror.
+        gp = self.gauge_payload(force=True)
+        if gp is not None:
+            report["gauge"] = gp
         with self.phases.phase("metrics"):
             self.master.call("ReportTaskResult", report)
 
@@ -1428,6 +1539,7 @@ class Worker:
                 self._report_result(report)
         if report["success"]:
             self._tasks_done += 1
+            self._g_tasks.inc()
             self._maybe_checkpoint()
 
     # ---- prep-ahead pipeline (fused + pipelined mode) ----
@@ -2055,6 +2167,7 @@ class Worker:
                 self._report_result(report)
             if report["success"]:
                 self._tasks_done += 1
+                self._g_tasks.inc()
                 self._maybe_checkpoint()
 
         # Settle the last pipelined tasks before the final checkpoint.

@@ -1026,6 +1026,99 @@ def test_chaos_discipline_waivable_and_exempts_error_paths():
     assert _lint(src, [ChaosDisciplinePass()]) == []
 
 
+# ---- gauge-discipline ----
+
+GAUGE_SEEDED = """
+    from elasticdl_tpu.common import gauge
+
+    class Worker:
+        def __init__(self):
+            self.gauges = gauge.Registry()
+            self._g_examples = self.gauges.counter("edl_examples_trained_total")
+
+        # hot-path: the steady-state task loop
+        def step(self):
+            self._g_examples.inc(64)
+            return self.gauges.snapshot()  # scrape from the hot path: finding
+"""
+
+GAUGE_CLEAN = """
+    from elasticdl_tpu.common import gauge
+
+    class Worker:
+        def __init__(self):
+            self.gauges = gauge.Registry()
+            self._g_examples = self.gauges.counter("edl_examples_trained_total")
+            self._g_step_ms = self.gauges.histogram("edl_step_ms")
+
+        # hot-path: the steady-state task loop
+        def step(self):
+            # O(1) ring/counter API: the only gauge calls legal here.
+            self._g_examples.inc(64)
+            self._g_step_ms.observe(8.2)
+            self.gauges.gauge("edl_lease_depth").set(3)
+
+        def gauge_payload(self):
+            # Not hot-path: snapshotting at a control-plane boundary is
+            # the intended pattern.
+            return {"families": self.gauges.snapshot()}
+"""
+
+
+def test_gauge_discipline_seeded_and_clean():
+    from elasticdl_tpu.analysis.gauge_discipline import GaugeDisciplinePass
+
+    findings = _lint(GAUGE_SEEDED, [GaugeDisciplinePass()])
+    assert _rules(findings) == {"gauge-discipline"}
+    assert len(findings) == 1
+    assert _lint(GAUGE_CLEAN, [GaugeDisciplinePass()]) == []
+
+
+def test_gauge_discipline_flags_render_and_aggregation_calls():
+    from elasticdl_tpu.analysis.gauge_discipline import GaugeDisciplinePass
+
+    src = """
+        class W:
+            # hot-path
+            def step(self, reg, fleet):
+                reg.render_prometheus()
+                fleet.fleet_snapshot()
+                reg.scalar_values(["edl_examples_trained_total"])
+    """
+    assert len(_lint(src, [GaugeDisciplinePass()])) == 3
+
+
+def test_gauge_discipline_ignores_unrelated_snapshot():
+    from elasticdl_tpu.analysis.gauge_discipline import GaugeDisciplinePass
+
+    src = """
+        class W:
+            # hot-path
+            def step(self):
+                # PhaseTimers/trainer snapshots are not gauge scrapes.
+                self.phases.snapshot()
+                self.trainer.snapshot_state()
+    """
+    assert _lint(src, [GaugeDisciplinePass()]) == []
+
+
+def test_gauge_discipline_waivable_and_exempts_error_paths():
+    from elasticdl_tpu.analysis.gauge_discipline import GaugeDisciplinePass
+
+    src = """
+        class W:
+            # hot-path
+            def step(self, reg):
+                # graftlint: allow[gauge-discipline] deliberate debug scrape
+                reg.render_prometheus()
+                try:
+                    pass
+                except Exception:
+                    reg.render_prometheus()  # error path: exempt
+    """
+    assert _lint(src, [GaugeDisciplinePass()]) == []
+
+
 # ---- the repo-wide gate ----
 
 def test_repo_lints_clean():

@@ -86,11 +86,12 @@ class ArtifactRun:
 #: ``latency_stats(..., buckets=True)``.  One FIXED grid across every
 #: artifact (serving_bench, ps_bench, straggler_report) so tail shapes are
 #: comparable file to file and round to round — per-run adaptive edges
-#: would make two artifacts' histograms incomparable.
-DEFAULT_BUCKET_EDGES_MS = (
-    0.1, 0.2, 0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 50.0,
-    100.0, 200.0, 500.0, 1000.0, 2000.0, 5000.0, 10000.0,
-)
+#: would make two artifacts' histograms incomparable.  Canonical home is
+#: ``common/gauge.py`` since r14: the LIVE registry histograms bucket on
+#: the same grid, so a scrape and a stamped artifact agree bin-for-bin
+#: (gauge.py is stdlib-only, so this import keeps the artifact path
+#: jax-free).  Re-exported here for the existing consumers.
+from elasticdl_tpu.common.gauge import DEFAULT_BUCKET_EDGES_MS  # noqa: E402,F401
 
 
 def latency_stats(

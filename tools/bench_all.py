@@ -229,6 +229,111 @@ def bench_config(name: str, batch_override: int = 0, measure: int = MEASURE) -> 
     return out
 
 
+def run_gauge_smoke() -> int:
+    """The graftgauge CI check (bench_all --gauge-smoke): live endpoints
+    answer mid-run with the instrumented families, watch_job renders a
+    live scrape, instrumentation overhead holds the <2% budget, and the
+    cross-rev trajectory gate passes non-empty.  Host-only (CPU-harness
+    subprocess fleet, no chip probe): the smoke measures the metrics
+    plane, not the accelerator."""
+    import tempfile
+
+    say = lambda m: print(f"[gauge-smoke] {m}", file=sys.stderr, flush=True)
+    problems = []
+
+    # 1. A real 1-worker job through the full master stack; chaos_bench's
+    # fleet runner scrapes the master's live endpoint every second
+    # mid-run and stamps the newest snapshot.
+    from tools.chaos_bench import run_fleet
+
+    tmp = tempfile.mkdtemp(prefix="gauge_smoke_")
+    fleet = run_fleet(
+        1, 6, tmp, say, "gauge", model="mnist", timeout_s=600.0
+    )
+    live = fleet.get("live_metrics") or {}
+    snap = live.get("snapshot") or {}
+    if not live.get("scrapes_ok"):
+        problems.append(
+            f"no successful mid-run scrape of the master endpoint "
+            f"({live.get('last_error', 'endpoint never came up')})"
+        )
+    for family in ("edl_fleet_examples_per_sec", "edl_world_size",
+                   "edl_dispatcher_done"):
+        if family not in snap:
+            problems.append(f"master family {family} missing from the "
+                            f"mid-run snapshot")
+    if not any(k.startswith("edl_examples_trained_total") for k in snap):
+        problems.append(
+            "no worker gauge envelope reached the fleet view "
+            "(edl_examples_trained_total absent)"
+        )
+
+    # 2. watch_job one-shot against a LIVE endpoint (the CLI path, end to
+    # end: bind, scrape, parse, render).
+    from elasticdl_tpu.common import gauge
+    from elasticdl_tpu.common.metrics_http import MetricsHTTPServer
+    from tools.watch_job import main as watch_main
+
+    reg = gauge.Registry()
+    reg.counter("edl_smoke_total", "gauge-smoke probe").inc(3)
+    probe_srv = MetricsHTTPServer(reg.render_prometheus, port=0).start()
+    try:
+        rc = watch_main([probe_srv.address])
+    finally:
+        probe_srv.stop()
+    if rc != 0:
+        problems.append(f"watch_job one-shot exited {rc}")
+
+    # 3. Instrumentation + scrape overhead on the ingest A/B harness.
+    from tools.ingest_bench import gauge_overhead_ab
+
+    ab = gauge_overhead_ab(say)
+    if ab["overhead_pct"] >= 2.0:
+        problems.append(
+            f"gauge overhead {ab['overhead_pct']}% >= 2% budget"
+        )
+
+    # 4. The cross-rev trajectory gate over the committed artifacts.
+    from tools.bench_regress import run_gate
+
+    trajectory = run_gate(log=say)
+    if not trajectory["series"]:
+        problems.append("bench_regress trajectory is EMPTY — the "
+                        "artifact indexer found nothing")
+    if not trajectory["compared"]:
+        problems.append("bench_regress compared zero cross-rev pairs")
+    if trajectory["regressions"]:
+        problems.append(
+            f"{len(trajectory['regressions'])} perf regression(s) in the "
+            "committed trajectory"
+        )
+
+    result = {
+        "metric": "gauge_smoke",
+        "live_metrics": live,
+        "fleet_tasks_done": fleet.get("tasks_done"),
+        "overhead": ab,
+        "trajectory_series": len(trajectory["series"]),
+        "trajectory_compared": trajectory["compared"],
+        "problems": problems,
+    }
+    from tools.artifact import write_artifact
+
+    write_artifact(result, "GAUGE_r14.json", env_var="GAUGE_OUT", log=say)
+    print(json.dumps(result), flush=True)
+    if problems:
+        for p in problems:
+            say(f"FAIL: {p}")
+        return 1
+    say(
+        f"PASS: {live.get('scrapes_ok')} live scrapes mid-run, overhead "
+        f"{ab['overhead_pct']}% < 2%, trajectory "
+        f"{len(trajectory['series'])} series / "
+        f"{trajectory['compared']} compared"
+    )
+    return 0
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", default="mnist,resnet50,resnet50_imagenet,wide_deep,transformer_lm")
@@ -270,7 +375,18 @@ def main() -> None:
         "2%% throughput delta — the recorded guarantee that tracing a "
         "production job is safe (docs/observability.md)",
     )
+    ap.add_argument(
+        "--gauge-smoke", action="store_true",
+        help="run ONLY the graftgauge smoke: a 1-worker job whose live "
+        "/metrics endpoints are scraped MID-RUN (fleet view + worker "
+        "families must answer), a watch_job one-shot over a live "
+        "endpoint, the gauge overhead A/B (<2%% budget), and the "
+        "bench_regress trajectory gate over the committed artifacts "
+        "(must be non-empty and regression-free)",
+    )
     args = ap.parse_args()
+    if args.gauge_smoke:
+        raise SystemExit(run_gauge_smoke())
     if args.chaos_smoke:
         # CPU-harness subprocess fleet, no chip probe: the smoke measures
         # the recovery machinery, not the accelerator.
@@ -368,6 +484,15 @@ def main() -> None:
                   f"p50 {p.get('p50_ms', '—')} ms, "
                   f"p99 {p.get('p99_ms', '—')} ms ({p['errors']} errors)",
                   file=sys.stderr)
+    # Cross-rev trajectory gate (r14): every battery ends by re-indexing
+    # the committed artifacts (including whatever this run just stamped)
+    # into artifacts/TRAJECTORY.json; a same-config metric that regressed
+    # past the threshold fails the run — the perf trajectory is a gated
+    # number now, not a docs/perf.md narrative.
+    from tools.bench_regress import run_gate
+
+    if run_gate()["regressions"]:
+        raise SystemExit(1)
 
 
 if __name__ == "__main__":

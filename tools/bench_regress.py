@@ -1,0 +1,310 @@
+"""bench_regress — the cross-rev perf-trajectory gate.
+
+Every bench in this repo stamps a committed JSON under ``artifacts/``
+(plus the occasional top-level ``BENCH_*.json`` from chip sessions), and
+the filenames carry the revision that produced them (``gang_ingest_r06``
+vs ``gang_ingest_r09``).  Until r14 nothing ever READ that trajectory:
+the cross-rev story lived in docs/perf.md prose, and a rev that silently
+regressed a previously-recorded number shipped clean.  This tool closes
+the loop:
+
+1. **Index**: scan ``artifacts/*.json`` + ``BENCH_*.json``, parse each
+   file's family (name with the ``_rNN`` revision stripped) and revision,
+   and extract the comparable metrics via the per-family extractor table
+   below (direction-annotated: examples/sec is higher-better, p99 and
+   recovery time are lower-better).
+2. **Trajectory**: group extracted points into per-(family, metric,
+   pipeline-config) series ordered by revision.  Two points compare only
+   when their declared pipeline configs agree on every key BOTH declare
+   (the bench.py record-guard stance: a sharded-optimizer run and a
+   replicated one never compete; an artifact that predates a config key
+   is unconstrained on it).  Same-rev duplicates (``bench_r05`` +
+   ``bench_r05_latest``) keep the direction-best value — record
+   semantics.
+3. **Gate**: the newest point of each series is compared against the
+   previous revision's; a direction-adjusted drop past ``--threshold``
+   (default 10%, generous because the CPU-box benches carry co-tenant
+   weather — see TRACE_r12's ab_note) is a REGRESSION: listed, stamped,
+   and exit 1.
+
+The whole trajectory is stamped into ``artifacts/TRAJECTORY.json`` (via
+``ArtifactRun`` — code_rev captured at tool entry, since this tool's own
+output dirties the tree it measures).  ``bench_all`` runs the gate after
+the full battery and on ``--gauge-smoke``.
+
+jax-free, stdlib + the artifact writer: runs in CI next to graftlint.
+
+Usage:
+  python tools/bench_regress.py [--threshold 10] [--repo PATH]
+      [--no-artifact] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+HIGHER = "higher"  # bigger is better (throughput)
+LOWER = "lower"    # smaller is better (latency, recovery time)
+
+#: Filename -> (family, rev).  ``gang_ingest_r09.json`` -> ("gang_ingest",
+#: 9); a ``_latest``/``_partial`` suffix folds into its base family;
+#: rev-less names get rev 0 (a family with one rev simply never compares).
+_REV_RE = re.compile(r"^(?P<family>.*?)_?r(?P<rev>\d+)(?P<suffix>_[a-z]+)?$",
+                     re.IGNORECASE)
+
+
+def parse_name(filename: str):
+    stem = os.path.splitext(os.path.basename(filename))[0]
+    m = _REV_RE.match(stem)
+    if not m:
+        return stem, 0
+    return m.group("family"), int(m.group("rev"))
+
+
+def _per_fleet(d: dict, field: str, direction: str) -> Dict[str, tuple]:
+    """One metric per fleet entry, keyed by worker count + group mode so a
+    1-worker number never compares against a 2-worker gang's."""
+    out: Dict[str, tuple] = {}
+    fleets = d.get("fleets")
+    items = (
+        fleets.items() if isinstance(fleets, dict)
+        else enumerate(fleets or [])
+    )
+    for _, f in items:
+        if not isinstance(f, dict) or field not in f:
+            continue
+        label = f.get("label") or (
+            f"{f.get('workers', '?')}w" + ("_gang" if f.get("group_mode") else "")
+        )
+        out[f"{field}[{label}]"] = (f.get(field), direction)
+    return out
+
+
+def _per_point(d: dict, field: str, direction: str) -> Dict[str, tuple]:
+    """serving_bench-style QPS points: one metric per offered-QPS row."""
+    out: Dict[str, tuple] = {}
+    for p in d.get("points") or []:
+        if isinstance(p, dict) and field in p:
+            out[f"{field}[qps{p.get('offered_qps')}]"] = (p[field], direction)
+    return out
+
+
+#: artifact "metric" field -> extractor(d) -> {name: (value, direction)}.
+#: Declarative so a new bench joins the gate by adding one line; families
+#: without an entry still index into the trajectory (for the record) but
+#: carry no gated metrics.
+EXTRACTORS = {
+    "deepfm_criteo_e2e_examples_per_sec_per_chip": lambda d: {
+        "e2e_examples_per_sec_per_chip": (d.get("value"), HIGHER),
+        "device_step_examples_per_sec_per_chip": (
+            d.get("device_step_examples_per_sec_per_chip"), HIGHER),
+    },
+    "gang_ingest_e2e_examples_per_sec": lambda d: _per_fleet(
+        d, "examples_per_sec", HIGHER),
+    "parallel_ingest_host_examples_per_sec": lambda d: {
+        "best_examples_per_sec": (
+            max((p.get("examples_per_sec", 0.0) for p in d.get("sweep") or []
+                 if isinstance(p, dict)), default=None), HIGHER),
+    },
+    "serving_latency_vs_qps": lambda d: {
+        **_per_point(d, "p50_ms", LOWER),
+        **_per_point(d, "p99_ms", LOWER),
+    },
+    "chaos_recovery_and_goodput_under_churn": lambda d: {
+        **_per_fleet(d, "examples_per_sec", HIGHER),
+        "kill_recovery_time_ms": (
+            ((d.get("fleets") or {}).get("kill") or {})
+            .get("recovery", {}).get("recovery_time_ms"), LOWER),
+    },
+    "ps_pull_push_latency": lambda d: {},  # indexed, not gated (shape varies)
+    "bench_all_configs": lambda d: {
+        f"examples_per_sec_per_chip[{c.get('config')}]": (
+            c.get("examples_per_sec_per_chip"), HIGHER)
+        for c in d.get("configs") or [] if isinstance(c, dict)
+    },
+}
+
+#: Keys that define "same pipeline config".  Two points compare only when
+#: they agree on every key BOTH declare — the record-guard stance: a
+#: missing key (an artifact predating it) is unconstrained, a conflicting
+#: one splits the series.
+CONFIG_KEYS = (
+    "jax_platforms", "pipeline", "harness", "config", "model",
+    "max_batch", "max_delay_ms", "clients", "workers", "unit",
+)
+
+
+def config_identity(d: dict) -> Dict[str, Any]:
+    return {k: d[k] for k in CONFIG_KEYS if k in d}
+
+
+def configs_comparable(a: Dict[str, Any], b: Dict[str, Any]) -> bool:
+    return all(a[k] == b[k] for k in a.keys() & b.keys())
+
+
+def index_artifacts(repo: str = _REPO_ROOT) -> List[dict]:
+    """Every readable artifact as {file, family, rev, metric, config,
+    metrics:{name: {value, direction}}} — the raw trajectory input."""
+    paths = sorted(
+        glob.glob(os.path.join(repo, "artifacts", "*.json"))
+        + glob.glob(os.path.join(repo, "BENCH_*.json"))
+    )
+    entries: List[dict] = []
+    for path in paths:
+        base = os.path.basename(path)
+        if base == "TRAJECTORY.json":
+            continue  # this tool's own output must not index itself
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, ValueError):
+            continue  # unreadable artifacts are not this gate's business
+        if not isinstance(d, dict):
+            continue
+        family, rev = parse_name(path)
+        extractor = EXTRACTORS.get(d.get("metric"))
+        metrics: Dict[str, dict] = {}
+        if extractor is not None:
+            for name, (value, direction) in extractor(d).items():
+                if isinstance(value, (int, float)) and not isinstance(
+                    value, bool
+                ):
+                    metrics[name] = {
+                        "value": float(value), "direction": direction
+                    }
+        entries.append({
+            "file": os.path.relpath(path, repo),
+            "family": family,
+            "rev": rev,
+            "metric": d.get("metric"),
+            "config": config_identity(d),
+            "metrics": metrics,
+        })
+    return entries
+
+
+def build_trajectory(entries: List[dict], threshold_pct: float) -> dict:
+    """Series per (family, metric-name), best-per-rev, latest-vs-previous
+    gated.  A config break between the two newest revs reports as
+    ``config_changed`` (skipped), never a regression."""
+    series: Dict[tuple, dict] = {}
+    for e in entries:
+        for name, m in e["metrics"].items():
+            key = (e["family"], name)
+            slot = series.setdefault(
+                key, {"family": e["family"], "name": name,
+                      "direction": m["direction"], "points": {}},
+            )
+            pts = slot["points"]
+            prev = pts.get(e["rev"])
+            better = (
+                prev is None
+                or (m["direction"] == HIGHER and m["value"] > prev["value"])
+                or (m["direction"] == LOWER and m["value"] < prev["value"])
+            )
+            if better:
+                pts[e["rev"]] = {
+                    "value": m["value"], "file": e["file"],
+                    "config": e["config"],
+                }
+    out_series: List[dict] = []
+    regressions: List[dict] = []
+    for slot in series.values():
+        pts = slot.pop("points")
+        revs = sorted(pts)
+        slot["points"] = [
+            {"rev": r, "value": pts[r]["value"], "file": pts[r]["file"]}
+            for r in revs
+        ]
+        slot["status"] = "single-point"
+        if len(revs) >= 2:
+            latest, prev = pts[revs[-1]], pts[revs[-2]]
+            if not configs_comparable(latest["config"], prev["config"]):
+                slot["status"] = "config_changed"
+            elif prev["value"] == 0:
+                slot["status"] = "zero-baseline"
+            else:
+                delta = (latest["value"] - prev["value"]) / abs(prev["value"])
+                if slot["direction"] == LOWER:
+                    delta = -delta
+                slot["latest_delta_pct"] = round(delta * 100, 2)
+                if delta * 100 < -threshold_pct:
+                    slot["status"] = "REGRESSED"
+                    regressions.append({
+                        "family": slot["family"], "name": slot["name"],
+                        "delta_pct": slot["latest_delta_pct"],
+                        "from": {"rev": revs[-2], **{
+                            k: prev[k] for k in ("value", "file")}},
+                        "to": {"rev": revs[-1], **{
+                            k: latest[k] for k in ("value", "file")}},
+                    })
+                else:
+                    slot["status"] = "ok"
+        out_series.append(slot)
+    out_series.sort(key=lambda s: (s["family"], s["name"]))
+    return {
+        "metric": "cross_rev_perf_trajectory",
+        "threshold_pct": threshold_pct,
+        "artifacts_indexed": len(entries),
+        "series": out_series,
+        "compared": sum(
+            1 for s in out_series if s["status"] in ("ok", "REGRESSED")),
+        "regressions": regressions,
+    }
+
+
+def run_gate(repo: str = _REPO_ROOT, threshold_pct: float = 10.0,
+             write: bool = True, log=None) -> dict:
+    """Index + trajectory + (optionally) stamp; the bench_all entry."""
+    from tools.artifact import ArtifactRun
+
+    run = ArtifactRun(repo)  # code_rev BEFORE our own output dirties it
+    say = log or (lambda m: print(m, file=sys.stderr, flush=True))
+    trajectory = build_trajectory(index_artifacts(repo), threshold_pct)
+    if write:
+        run.write(
+            trajectory, "TRAJECTORY.json", env_var="TRAJECTORY_OUT",
+            path=os.path.join(repo, "artifacts", "TRAJECTORY.json"),
+            log=say,
+        )
+    for s in trajectory["series"]:
+        if s["status"] in ("ok", "REGRESSED"):
+            say(f"  {s['family']}/{s['name']}: "
+                f"{s['latest_delta_pct']:+.1f}% ({s['status']})")
+    for r in trajectory["regressions"]:
+        say(f"REGRESSION {r['family']}/{r['name']}: {r['delta_pct']:+.1f}% "
+            f"({r['from']['file']} -> {r['to']['file']})")
+    return trajectory
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument("--threshold", type=float, default=10.0,
+                    help="regression gate in percent (direction-adjusted)")
+    ap.add_argument("--repo", default=_REPO_ROOT)
+    ap.add_argument("--no-artifact", action="store_true",
+                    help="gate only; do not rewrite TRAJECTORY.json")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full trajectory JSON to stdout")
+    args = ap.parse_args(argv)
+    trajectory = run_gate(
+        repo=args.repo, threshold_pct=args.threshold,
+        write=not args.no_artifact,
+    )
+    if args.json:
+        print(json.dumps(trajectory, indent=1))
+    return 1 if trajectory["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -211,6 +211,35 @@ def _chaos_event_counts(dump: dict, pod_log_dir: str = "") -> Dict[str, int]:
     return counts
 
 
+def _scrape_loop(address: str, stop, box: dict) -> None:
+    """Poll ``address``'s /metrics once a second until ``stop``; bank the
+    newest parsed snapshot (scalar edl_* families flattened to
+    name{labels} -> value) plus ok/failed tallies.  Runs while the fleet
+    is faulted ON PURPOSE: a scrape that only works on a healthy job
+    proves nothing."""
+    from tools.watch_job import fetch
+
+    while not stop.is_set():
+        try:
+            families = fetch(address, timeout_s=2.0)
+        except Exception as e:  # noqa: BLE001 — tallied; the job goes on
+            box["scrapes_failed"] = box.get("scrapes_failed", 0) + 1
+            box["last_error"] = f"{type(e).__name__}: {e}"
+        else:
+            flat = {}
+            for name, fam in sorted(families.items()):
+                if not name.startswith("edl_") or fam.get("type") == "histogram":
+                    continue
+                for s in fam["samples"]:
+                    labels = ",".join(
+                        f"{k}={v}" for k, v in sorted(s["labels"].items())
+                    )
+                    flat[f"{name}{{{labels}}}" if labels else name] = s["value"]
+            box["snapshot"] = flat
+            box["scrapes_ok"] = box.get("scrapes_ok", 0) + 1
+        stop.wait(1.0)
+
+
 def run_fleet(
     n_workers: int,
     n_tasks: int,
@@ -294,6 +323,11 @@ def run_fleet(
         gang_deadline_ms=gang_deadline_ms,
         checkpoint_steps=0,
         pod_log_dir=os.path.join(tmp, f"pods-{label}"),
+        # graftgauge (r14): every process of the fleet serves /metrics on
+        # an ephemeral port; the bench scrapes the MASTER's endpoint
+        # mid-run (below) — the fleet-aggregated view must answer while a
+        # fault is in flight, which is the whole claim.
+        gauge_port=0,
     )
     # Isolate each fleet's trace window: the process recorder is global,
     # and a previous fleet's instants must not leak into this timeline.
@@ -312,7 +346,24 @@ def run_fleet(
     t0 = time.perf_counter()
     runner = threading.Thread(target=_run, name=f"chaos-{label}", daemon=True)
     runner.start()
+    # Live mid-run scrape (r14): poll the master's /metrics every second
+    # WHILE the fleet runs (including while a stall has the gang wedged —
+    # the scrape server's daemon threads are the availability claim) and
+    # keep the newest snapshot for the artifact.
+    scrape_box: dict = {}
+    scrape_stop = threading.Event()
+    scraper = None
+    if master.metrics_server is not None:
+        scraper = threading.Thread(
+            target=_scrape_loop,
+            args=(master.metrics_server.address, scrape_stop, scrape_box),
+            name=f"chaos-scrape-{label}", daemon=True,
+        )
+        scraper.start()
     runner.join(timeout=timeout_s)
+    scrape_stop.set()
+    if scraper is not None:
+        scraper.join(timeout=5.0)
     wall = time.perf_counter() - t0
     if runner.is_alive():
         # The watchdog IS part of the experiment: a chaos run that wedges
@@ -355,6 +406,21 @@ def run_fleet(
         "chaos_events": _chaos_event_counts(
             dump, os.path.join(tmp, f"pods-{label}")
         ),
+        # The newest mid-run scrape of the master's live endpoint: proof
+        # the fleet view answered DURING the injected faults.
+        "live_metrics": {
+            "endpoint": (
+                master.metrics_server.address
+                if master.metrics_server is not None else None
+            ),
+            "scrapes_ok": scrape_box.get("scrapes_ok", 0),
+            "scrapes_failed": scrape_box.get("scrapes_failed", 0),
+            **(
+                {"last_error": scrape_box["last_error"]}
+                if "last_error" in scrape_box else {}
+            ),
+            "snapshot": scrape_box.get("snapshot") or {},
+        },
         "recovery": _splice_timeline(dump.get("master_events") or []),
         # The explicit exactly-once verdict the artifact is judged on.
         "zero_double_train": (

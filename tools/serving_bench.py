@@ -202,6 +202,11 @@ def run_bench(
         max_delay_ms=max_delay_ms,
         cache_rows=cache_rows,
         poll_interval_s=0.2,
+        # graftgauge (r14): serve /metrics on an ephemeral port; the
+        # bench scrapes it mid-point (under live load, around the hot
+        # reload) and stamps the snapshot — the endpoint must answer
+        # while the replica is busy, not just at rest.
+        gauge_port=0,
     ).start()
     warmup_s = server.warmup()
     say(f"serving up on {server.address} (compile {warmup_s:.2f}s)")
@@ -209,6 +214,7 @@ def run_bench(
     feed = _RequestFeed(n=4096, buckets=buckets)
     points = []
     reload_info: Dict = {"performed": False}
+    live_metrics: Dict = {"endpoint": server.metrics_address}
     probe = ServingClient(server.address)
     try:
         probe.wait_ready(10.0)
@@ -237,9 +243,36 @@ def run_bench(
 
                 reloader = threading.Thread(target=do_reload, daemon=True)
                 reloader.start()
+            scraper = None
+            if idx == mid and server.metrics_address:
+                # Mid-point live scrape: lands while this point's load
+                # (and the reload, when enabled) is in flight.
+                def do_scrape():
+                    time.sleep(duration_s / 3)
+                    try:
+                        from tools.watch_job import fetch
+
+                        fams = fetch(server.metrics_address, timeout_s=5.0)
+                        live_metrics["snapshot"] = {
+                            name: [
+                                {"labels": s["labels"], "value": s["value"]}
+                                for s in fam["samples"]
+                            ]
+                            for name, fam in sorted(fams.items())
+                            if name.startswith("edl_serving")
+                            and fam.get("type") != "histogram"
+                        }
+                        live_metrics["during_offered_qps"] = qps
+                    except Exception as e:  # noqa: BLE001 — stamped, not fatal
+                        live_metrics["error"] = f"{type(e).__name__}: {e}"
+
+                scraper = threading.Thread(target=do_scrape, daemon=True)
+                scraper.start()
             point = _drive_point(
                 server.address, feed, qps, duration_s, n_clients
             )
+            if scraper is not None:
+                scraper.join(duration_s + 10.0)
             if reloader is not None:
                 reloader.join(30.0)
                 point["reload_during_point"] = True
@@ -266,6 +299,7 @@ def run_bench(
             "warmup_compile_s": round(warmup_s, 2),
             "points": points,
             "reload": reload_info,
+            "live_metrics": live_metrics,
             "batcher": info["batcher"],
             "embedding_cache": info["cache"],
             "serving_step": info["step"],

@@ -1,0 +1,247 @@
+"""watch_job — render any live /metrics endpoint in the terminal.
+
+The reading half of graftgauge's zero-infrastructure story: every process
+of a job (master, workers, PS shards, the serving replica) serves
+Prometheus text on its ``[graftgauge] serving /metrics on <addr>``
+pod-log address, and this tool turns one of those endpoints into a
+one-shot table or a polling dashboard — no Prometheus server, no
+Grafana, jax-free, stdlib-only (it must run on the operator's laptop or
+inside a CI step that never pays a jax import).
+
+Usage:
+  python tools/watch_job.py HOST:PORT                  # one-shot table
+  python tools/watch_job.py HOST:PORT --interval 2     # poll every 2 s
+  python tools/watch_job.py HOST:PORT --json           # parsed families
+  python tools/watch_job.py HOST:PORT --families edl_fleet,edl_goodput
+  python tools/watch_job.py HOST:PORT --healthz        # liveness JSON
+
+The master's endpoint is the fleet view: per-worker families arrive with
+a ``worker`` label, the goodput/SLO computer's gauges
+(``edl_fleet_examples_per_sec``, ``edl_goodput_under_churn``,
+``edl_gang_arrival_lag_seconds``, ...) sit beside them.  Histograms
+render as count/sum plus the shared log-grid buckets' p50/p99 estimate
+(the same arithmetic the registry's ``quantile`` uses).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+
+def _url(address: str, path: str = "/metrics") -> str:
+    if address.startswith(("http://", "https://")):
+        base = address.rstrip("/")
+        # An explicit path in the URL wins (scraping through a proxy).
+        return base if "/" in base.split("//", 1)[1] else base + path
+    return f"http://{address}{path}"
+
+
+def fetch_text(address: str, path: str = "/metrics",
+               timeout_s: float = 5.0) -> str:
+    with urllib.request.urlopen(_url(address, path), timeout=timeout_s) as r:
+        return r.read().decode()
+
+
+def _parse_labels(body: str) -> Dict[str, str]:
+    """``a="b",c="d"`` -> dict.  The renderer never emits quotes/commas
+    inside values (labels come from worker ids / phase names), so a
+    simple split is exact for our own exposition."""
+    out: Dict[str, str] = {}
+    for part in body.split(","):
+        if "=" in part:
+            k, v = part.split("=", 1)
+            out[k.strip()] = v.strip().strip('"')
+    return out
+
+
+def parse_prometheus(text: str) -> Dict[str, dict]:
+    """Prometheus text -> ``{family: {"type", "help", "samples": [
+    {"name", "labels", "value"}]}}`` — the inverse of
+    ``gauge.render_families`` (histogram ``_bucket``/``_sum``/``_count``
+    series stay flat samples under their family).  Malformed lines are
+    skipped: this parses OUR renderer's output, but a scrape racing a
+    process exit may truncate mid-line."""
+    families: Dict[str, dict] = {}
+
+    def fam(name: str) -> dict:
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if base.endswith(suffix) and base[: -len(suffix)] in families:
+                base = base[: -len(suffix)]
+                break
+        return families.setdefault(
+            base, {"type": "untyped", "help": "", "samples": []}
+        )
+
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            rest = line[len("# HELP "):].split(" ", 1)
+            fam(rest[0])["help"] = rest[1] if len(rest) > 1 else ""
+            continue
+        if line.startswith("# TYPE "):
+            rest = line[len("# TYPE "):].split(" ", 1)
+            fam(rest[0])["type"] = rest[1].strip() if len(rest) > 1 else ""
+            continue
+        if line.startswith("#"):
+            continue
+        try:
+            metric, value_s = line.rsplit(" ", 1)
+            value = float(value_s)
+        except ValueError:
+            continue
+        labels: Dict[str, str] = {}
+        name = metric
+        if "{" in metric and metric.endswith("}"):
+            name, body = metric.split("{", 1)
+            labels = _parse_labels(body[:-1])
+        fam(name)["samples"].append(
+            {"name": name, "labels": labels, "value": value}
+        )
+    return families
+
+
+def _hist_stats(samples: List[dict], series_key: Tuple[Tuple[str, str], ...]):
+    """count/sum/p50/p99 of one histogram series from its flat
+    ``_bucket``/``_sum``/``_count`` samples (cumulative buckets; the
+    quantile interpolates inside the owning bucket — the registry's own
+    estimator)."""
+    buckets: List[Tuple[float, float]] = []
+    total = s = 0.0
+    for sample in samples:
+        labels = dict(sample["labels"])
+        le = labels.pop("le", None)
+        key = tuple(sorted(labels.items()))
+        if key != series_key:
+            continue
+        if sample["name"].endswith("_bucket") and le is not None:
+            edge = float("inf") if le == "+Inf" else float(le)
+            buckets.append((edge, sample["value"]))
+        elif sample["name"].endswith("_count"):
+            total = sample["value"]
+        elif sample["name"].endswith("_sum"):
+            s = sample["value"]
+    buckets.sort()
+
+    def q(p: float) -> Optional[float]:
+        if total <= 0 or not buckets:
+            return None
+        target = p * total
+        prev_edge, prev_cum = 0.0, 0.0
+        for edge, cum in buckets:
+            if cum >= target:
+                if edge == float("inf"):
+                    return prev_edge
+                frac = (target - prev_cum) / max(cum - prev_cum, 1e-12)
+                return prev_edge + (edge - prev_edge) * frac
+            prev_edge, prev_cum = (0.0 if edge == float("inf") else edge), cum
+        return prev_edge
+    return total, s, q(0.5), q(0.99)
+
+
+def render_table(families: Dict[str, dict],
+                 prefixes: Optional[List[str]] = None) -> str:
+    """One aligned line per series; histograms summarize to
+    count/mean/p50/p99."""
+    lines: List[str] = []
+    for name in sorted(families):
+        if prefixes and not any(name.startswith(p) for p in prefixes):
+            continue
+        fam = families[name]
+        samples = fam["samples"]
+        if fam["type"] == "histogram":
+            keys = sorted({
+                tuple(sorted(
+                    (k, v) for k, v in s["labels"].items() if k != "le"
+                ))
+                for s in samples
+            })
+            for key in keys:
+                count, total, p50, p99 = _hist_stats(samples, key)
+                label_s = (
+                    "{" + ",".join(f"{k}={v}" for k, v in key) + "}"
+                    if key else ""
+                )
+                mean = total / count if count else 0.0
+                lines.append(
+                    f"{name}{label_s:<28} n={count:<8.0f} "
+                    f"mean={mean:<9.2f} p50~{0 if p50 is None else p50:<9.2f} "
+                    f"p99~{0 if p99 is None else p99:.2f}"
+                )
+            continue
+        for sample in samples:
+            label_s = (
+                "{" + ",".join(
+                    f"{k}={v}" for k, v in sorted(sample["labels"].items())
+                ) + "}" if sample["labels"] else ""
+            )
+            v = sample["value"]
+            v_s = str(int(v)) if v == int(v) else f"{v:.4g}"
+            lines.append(f"{sample['name']}{label_s:<40} {v_s}")
+    return "\n".join(lines)
+
+
+def fetch(address: str, timeout_s: float = 5.0) -> Dict[str, dict]:
+    """One scrape, parsed — the programmatic entry (benches stamp this
+    as their ``live_metrics`` snapshot)."""
+    return parse_prometheus(fetch_text(address, timeout_s=timeout_s))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument("address", help="HOST:PORT (or full URL) of a "
+                    "/metrics endpoint — the [graftgauge] pod-log line")
+    ap.add_argument("--interval", type=float, default=0.0,
+                    help="poll every N seconds (0 = one-shot)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the parsed families as JSON")
+    ap.add_argument("--families", default="",
+                    help="comma list of family-name prefixes to show")
+    ap.add_argument("--healthz", action="store_true",
+                    help="fetch /healthz instead of /metrics")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    args = ap.parse_args(argv)
+    prefixes = [p for p in args.families.split(",") if p]
+
+    def once() -> None:
+        if args.healthz:
+            body = fetch_text(args.address, "/healthz", args.timeout)
+            print(json.dumps(json.loads(body), indent=None if args.json else 1))
+            return
+        families = fetch(args.address, args.timeout)
+        if prefixes:
+            families = {
+                n: f for n, f in families.items()
+                if any(n.startswith(p) for p in prefixes)
+            }
+        if args.json:
+            print(json.dumps(families, sort_keys=True))
+        else:
+            print(render_table(families))
+
+    if args.interval <= 0:
+        once()
+        return 0
+    try:
+        while True:
+            print(f"--- {args.address} @ "
+                  f"{time.strftime('%H:%M:%S')} ---")
+            try:
+                once()
+            except OSError as e:  # endpoint briefly unreachable: keep polling
+                print(f"(scrape failed: {e})", file=sys.stderr)
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
