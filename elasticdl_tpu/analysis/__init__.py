@@ -13,6 +13,12 @@ gated, not reviewed, into compliance:
                         ``phases.phase(...)`` accounting boundary
 - ``compat-shim``       raw ``shard_map`` / ``jax.distributed.initialize``
                         / ``lax.axis_size`` only in ``common/jax_compat.py``
+- ``collective-shim``   raw ``lax.psum`` / ``lax.pmean`` /
+                        ``lax.psum_scatter`` only in
+                        ``parallel/collectives.py`` (graftreduce, r15) and
+                        ``common/jax_compat.py`` — reductions must route
+                        through the layer that owns topology routing and
+                        subgroup renormalization
 - ``rpc-discipline``    stub call sites carry a timeout or route through a
                         retry wrapper
 - ``thread-hygiene``    every ``threading.Thread`` is daemonized or joined
@@ -64,6 +70,7 @@ linter must never pay (or hang on) a jax import.
 
 from elasticdl_tpu.analysis.blocking import BlockingPropagationPass
 from elasticdl_tpu.analysis.chaos_discipline import ChaosDisciplinePass
+from elasticdl_tpu.analysis.collective_shim import CollectiveShimPass
 from elasticdl_tpu.analysis.compat_shim import CompatShimPass
 from elasticdl_tpu.analysis.core import (  # noqa: F401
     Finding,
@@ -92,6 +99,7 @@ def all_passes() -> list:
         HotPathSyncPass(),
         BlockingPropagationPass(),
         CompatShimPass(),
+        CollectiveShimPass(),
         RpcDisciplinePass(),
         ThreadHygienePass(),
         ImportHygienePass(),

@@ -1,0 +1,87 @@
+"""collective-shim: every reduction routes through the graftreduce layer.
+
+r15 built ``parallel/collectives.py`` — ONE module owning how gradient
+and metric reductions run (flat vs hierarchical topology routing, and
+the subgroup-weight renormalization of timeout-bounded participation).
+A raw ``lax.psum`` call site bypasses all of it: it is always flat, it
+cannot be excluded-and-renormalized, and the next topology change would
+have to find it by hand (exactly the r6 shard_map hunt the compat-shim
+pass mechanized).  So, outside the two shim modules —
+``parallel/collectives.py`` itself and ``common/jax_compat.py`` (whose
+``axis_size`` fallback is a psum of the unit constant) — the following
+are findings:
+
+- ``lax.psum`` / ``lax.pmean`` / ``lax.psum_scatter`` attribute use
+  (and the ``jax.lax.*`` spellings);
+- ``from jax.lax import psum`` / ``pmean`` / ``psum_scatter`` — an
+  import alias would otherwise smuggle the raw spelling past the
+  attribute check.
+
+``lax.all_gather`` / ``lax.ppermute`` stay legal: they move data, they
+do not reduce — the renormalization and hierarchy concerns that make
+reductions shim-worthy do not apply.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from elasticdl_tpu.analysis.core import Finding, LintPass, SourceFile, attr_chain
+
+#: Modules allowed to spell the raw reductions.
+SHIM_MODULE_SUFFIXES = (
+    "parallel/collectives.py",
+    "common/jax_compat.py",
+)
+
+_REDUCTIONS = ("psum", "pmean", "psum_scatter")
+
+_FORBIDDEN_ATTR_CHAINS = {
+    f"{prefix}.{name}": name
+    for name in _REDUCTIONS
+    for prefix in ("lax", "jax.lax")
+}
+
+_SHIM_HINT = {
+    "psum": "elasticdl_tpu.parallel.collectives.psum",
+    "pmean": "elasticdl_tpu.parallel.collectives.pmean",
+    "psum_scatter": "elasticdl_tpu.parallel.collectives.psum_scatter",
+}
+
+
+class CollectiveShimPass(LintPass):
+    name = "collective-shim"
+    description = (
+        "raw lax.psum / lax.pmean / lax.psum_scatter only inside "
+        "parallel/collectives.py and common/jax_compat.py"
+    )
+
+    def run(self, src: SourceFile) -> Iterable[Finding]:
+        path = src.path.replace("\\", "/")
+        if any(path.endswith(s) for s in SHIM_MODULE_SUFFIXES):
+            return ()
+        findings: List[Finding] = []
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if mod == "jax.lax" or mod.startswith("jax.lax."):
+                    for alias in node.names:
+                        if alias.name in _REDUCTIONS:
+                            findings.append(Finding(
+                                self.name, src.path, node.lineno,
+                                f"raw {alias.name} import bypasses the "
+                                "collective layer — use "
+                                f"{_SHIM_HINT[alias.name]} (graftreduce)",
+                            ))
+            elif isinstance(node, ast.Attribute):
+                chain = attr_chain(node)
+                name = _FORBIDDEN_ATTR_CHAINS.get(chain)
+                if name is not None:
+                    findings.append(Finding(
+                        self.name, src.path, node.lineno,
+                        f"raw {chain} bypasses the collective layer — use "
+                        f"{_SHIM_HINT[name]} (graftreduce: topology routing "
+                        "+ subgroup renormalization live there)",
+                    ))
+        return findings

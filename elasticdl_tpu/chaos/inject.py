@@ -36,6 +36,10 @@ semicolon-separated faults, comma-separated ``key=value`` args)::
                                          # NOT re-match, so a kill cannot
                                          # crash-loop its own relaunch
     stall:rank=0,point=prep,step=2,ms=500,count=2
+    stall:rank=0,point=collective,shard=1,ms=2000   # stall ONE dp
+                                         # shard's contribution at the
+                                         # r15 in-step gate (shard= only
+                                         # applies here)
     delay_rpc:method=GetTask,ms=100,count=3
     drop_rpc:method=Heartbeat,count=2,skip=5
     delay_ps:ms=50,count=4
@@ -43,7 +47,7 @@ semicolon-separated faults, comma-separated ``key=value`` args)::
 Fault kinds -> hook points (the wire contract with the call sites):
 
     kill       worker:task            os._exit(CHAOS_KILL_EXIT_CODE)
-    stall      worker:{task,prep,step}  time.sleep(ms)
+    stall      worker:{task,prep,step,collective}  time.sleep(ms)
     delay_rpc  rpc:client             time.sleep(ms) before the send
     drop_rpc   rpc:client             raise ChaosRpcDropped (the caller
                                       sees a failed RPC, exactly as a
@@ -90,7 +94,9 @@ class ChaosRpcDropped(RuntimeError):
 #: kind -> hook points it may fire at.
 _KIND_POINTS = {
     "kill": ("worker:task",),
-    "stall": ("worker:task", "worker:prep", "worker:step"),
+    "stall": (
+        "worker:task", "worker:prep", "worker:step", "worker:collective",
+    ),
     "delay_rpc": ("rpc:client",),
     "drop_rpc": ("rpc:client",),
     "delay_ps": ("ps:pull",),
@@ -104,7 +110,9 @@ _KIND_POINTS = {
 #: worker rank and no step mirror, so those conditions could never match.
 _KIND_KEYS = {
     "kill": {"rank", "worker", "step", "count", "skip"},
-    "stall": {"rank", "worker", "step", "point", "ms", "count", "skip"},
+    "stall": {
+        "rank", "worker", "step", "point", "shard", "ms", "count", "skip",
+    },
     "delay_rpc": {"rank", "worker", "step", "method", "ms", "count", "skip"},
     "drop_rpc": {"rank", "worker", "step", "method", "count", "skip"},
     "delay_ps": {"ms", "count", "skip"},
@@ -120,6 +128,7 @@ class ChaosFault:
     worker: str = ""
     step: int = 0
     point: str = ""
+    shard: Optional[int] = None
     method: str = ""
     ms: float = 0.0
     count: int = 1
@@ -138,6 +147,8 @@ class ChaosFault:
             if point != f"worker:{self.point or 'step'}":
                 return False
         if self.method and ctx.get("method") != self.method:
+            return False
+        if self.shard is not None and ctx.get("shard") != self.shard:
             return False
         if self.rank is not None and ctx.get("rank") != self.rank:
             return False
@@ -171,7 +182,7 @@ def parse_plan(spec: str) -> List[ChaosFault]:
                     f"chaos arg {key!r} does not apply to {kind!r} in "
                     f"{entry!r} (accepted: {sorted(_KIND_KEYS[kind])})"
                 )
-            if key in ("rank", "step", "count", "skip"):
+            if key in ("rank", "step", "count", "skip", "shard"):
                 kwargs[key] = int(value)
             elif key == "ms":
                 kwargs[key] = float(value)
@@ -180,9 +191,20 @@ def parse_plan(spec: str) -> List[ChaosFault]:
         fault = ChaosFault(kind=kind, **kwargs)
         if fault.kind in ("stall", "delay_rpc", "delay_ps") and fault.ms <= 0:
             raise ChaosError(f"{entry!r} needs ms=<positive duration>")
-        if fault.point and fault.point not in ("task", "prep", "step"):
+        if fault.point and fault.point not in (
+            "task", "prep", "step", "collective"
+        ):
             raise ChaosError(
-                f"{entry!r}: point must be task|prep|step, got {fault.point!r}"
+                f"{entry!r}: point must be task|prep|step|collective, got "
+                f"{fault.point!r}"
+            )
+        if fault.shard is not None and fault.point != "collective":
+            # shard= addresses one dp contributor crossing the r15
+            # collective gate; no other hook point carries a shard, so
+            # the condition could never match — a fault that silently
+            # never fires (the parse-error stance above).
+            raise ChaosError(
+                f"{entry!r}: shard= applies only to point=collective"
             )
         faults.append(fault)
     return faults
@@ -269,7 +291,7 @@ class ChaosInjector:
         trace.instant(
             f"chaos:{fault.kind}", cat="chaos", point=point,
             ms=fault.ms, rank=ctx.get("rank"), method=ctx.get("method"),
-            step=ctx.get("step"), fired=fault.fired,
+            step=ctx.get("step"), shard=ctx.get("shard"), fired=fault.fired,
         )
         import sys
 

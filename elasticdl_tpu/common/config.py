@@ -181,6 +181,44 @@ class JobConfig:
     # (elastic resizes that break divisibility fall back to 1-D).
     dcn_data_parallelism: int = 1
 
+    # --- collectives (r15, parallel/collectives.py — graftreduce) ---
+    # How gradient/metric reductions run over the data-parallel axis:
+    #   flat         — one all-replica collective per reduction (pre-r15);
+    #   hierarchical — big leaves reduce intra-host first (reduce-scatter
+    #                  over the cheap hop), then inter-host over the
+    #                  1/n_local residue, then re-gather locally — cutting
+    #                  inter-host bytes by the local fan-in.  Falls back
+    #                  to flat when the mesh presents no (host, local)
+    #                  factorization (single host and no
+    #                  --collective_local_size override);
+    #   auto         — hierarchical exactly when the mesh's real process
+    #                  grouping (or the override) factors the axis.
+    # Flat-vs-hierarchical parity is float reduction order only
+    # (artifacts/COLLECT_r15.json stamps the probe).
+    collective: str = "auto"
+    # Pin (or, on the CPU harness, emulate) the intra-host fan-in: how
+    # many consecutive positions of the dp axis count as one host's
+    # local group.  0 = derive from the mesh's process grouping
+    # (parallel/mesh.dp_factorization).  Must divide the axis size.
+    collective_local_size: int = 0
+    # Leaves smaller than this many elements always reduce with ONE flat
+    # collective — a scalar's three hierarchical launches cost more than
+    # the inter-host bytes they save.
+    collective_min_elems: int = 4096
+    # In-step (in-collective) straggler deadline, milliseconds.  > 0 arms
+    # the worker's collective gate (single-process meshes): each dp
+    # shard's host-side contribution must be ready within this bound or
+    # the step dispatches WITHOUT it — the shard's weight in the
+    # subgroup mask drops to 0, every mean renormalizes over the
+    # survivors (sum/|G'|), and the exclusion is charged against the
+    # same bounded skip accounting as the r13 task-boundary deadline
+    # (gang_skip_budget consecutive exclusions of one shard escalate to
+    # waiting it out, so a dead contributor surfaces as a visible stall,
+    # never silent data loss).  The exclusion mask is an INPUT to the
+    # jitted step: changing the excluded set never recompiles.  0 =
+    # disabled (a stalled contributor blocks the dispatch, pre-r15).
+    collective_deadline_ms: float = 0.0
+
     # --- elasticity ---
     relaunch_on_worker_failure: bool = True
     max_worker_relaunch: int = 3
@@ -338,6 +376,22 @@ class JobConfig:
             raise ValueError("--async_staleness must be >= 1")
         if self.dcn_data_parallelism < 1:
             raise ValueError("--dcn_data_parallelism must be >= 1")
+        # Kept in sync with parallel.collectives.MODES (asserted by
+        # tests); not imported from there so this module stays jax-free.
+        if self.collective not in ("flat", "hierarchical", "auto"):
+            raise ValueError(
+                f"--collective must be flat|hierarchical|auto, got "
+                f"{self.collective!r}"
+            )
+        if self.collective_local_size < 0:
+            raise ValueError(
+                "--collective_local_size cannot be negative (0 = derive "
+                "from the mesh's process grouping)"
+            )
+        if self.collective_min_elems < 1:
+            raise ValueError("--collective_min_elems must be >= 1")
+        if self.collective_deadline_ms < 0:
+            raise ValueError("--collective_deadline_ms cannot be negative")
         if self.optimizer_sharding not in ("replicated", "sharded", "auto"):
             raise ValueError(
                 f"--optimizer_sharding must be replicated|sharded|auto, got "

@@ -201,7 +201,7 @@ class PhaseTimers:
 #: decomposition against wall time must restrict to these.
 CRITICAL_PATH_PHASES = (
     "prep_wait", "dispatch", "step_wait", "metrics", "checkpoint", "control",
-    "lease_wait",
+    "lease_wait", "collective_gate",
 )
 
 

@@ -124,7 +124,16 @@ MASTER_SCHEMAS: Dict[str, MessageSchema] = {
         # wedges, so only begun-dispatch — riding the background beat,
         # the one RPC a wedged gang still sends — tells the straggler
         # from the ranks blocked in the collective on it.
-        optional={"version": _INT, "phase_times": _DICT, "gang_seq": _INT},
+        # collective_skips (r15): cumulative in-collective straggler
+        # exclusions charged by the worker's in-step deadline gate
+        # (graftreduce) — the master banks the newest value per worker
+        # into the same bounded-skip ledger the r13 boundary deadline
+        # feeds (JobStatus).  Additive and optional: no PROTOCOL_VERSION
+        # bump, the r9/r12/r14 stance.
+        optional={
+            "version": _INT, "phase_times": _DICT, "gang_seq": _INT,
+            "collective_skips": _INT,
+        },
     ),
     "GetMembership": MessageSchema(),
     "GetCheckpoint": MessageSchema(),

@@ -161,6 +161,10 @@ class MasterServicer:
         self._gang_arrivals: Dict[str, tuple] = {}  # guarded-by: _group_lock
         self._gang_head: tuple = (0, None)  # (seq, first-ask t)  guarded-by: _group_lock
         self._skipped_ranks: Dict[str, int] = {}  # guarded-by: _lock
+        # r15 in-collective exclusions: newest cumulative count per
+        # worker, heartbeat-borne (the in-step layer of the same
+        # bounded-skip story _skipped_ranks tracks at the boundary).
+        self._collective_skips: Dict[str, int] = {}  # guarded-by: _lock
         # Ranks maybe_skip_straggler evicted whose processes are still
         # alive: their background liveness beats keep arriving, and the
         # rendezvous heartbeat would REVIVE an unknown worker — re-adding
@@ -716,6 +720,7 @@ class MasterServicer:
             state = {
                 "model_version": self._model_version,
                 "skipped_ranks": dict(self._skipped_ranks),
+                "collective_skips": dict(self._collective_skips),
                 "phase_times": {
                     w: dict(p) for w, p in self._phase_times.items()
                 },
@@ -838,6 +843,14 @@ class MasterServicer:
         # exactly when the operator needs it.  Bank-only — the JSONL
         # mirror rides checkpoint reports (bounded frequency).
         self._record_gauges(req)
+        # In-collective skip ledger (r15): the worker's cumulative
+        # in-step exclusions — newest value wins (the counter only
+        # grows), banked beside the r13 per-rank boundary skips so
+        # JobStatus serves both layers of the deadline story.
+        cs = req.get("collective_skips")
+        if cs is not None:
+            with self._lock:
+                self._collective_skips[req["worker_id"]] = int(cs)
         # Gang-deadline watchdog (r13): heartbeats are the only RPCs still
         # arriving when the whole gang is wedged in a collective on a
         # straggler — the beat both FEEDS the per-rank progress signal
@@ -983,6 +996,9 @@ class MasterServicer:
             # r13 tail tolerance: per-rank deadline-skip counts, beside
             # the dispatcher's per-task accounting already in ``status``.
             status["skipped_ranks"] = dict(self._skipped_ranks)
+            # r15 graftreduce: in-collective exclusions per worker (the
+            # in-step layer of the same bounded-skip accounting).
+            status["collective_skips"] = dict(self._collective_skips)
             depth_fn = self._standby_depth_fn
         if depth_fn is not None:
             depth = depth_fn()

@@ -97,6 +97,7 @@ import numpy as np
 from jax import lax
 
 from elasticdl_tpu.common.jax_compat import axis_size
+from elasticdl_tpu.parallel import collectives
 
 # TPU vreg lane count: physical rows are packed to (at most) this many lanes.
 LANES = 128
@@ -384,7 +385,9 @@ def _dense_lookup(local_table: jax.Array, ids: jax.Array, axis_name: str, dim: i
 
     # Route each device its own block, summing over shards (one nonzero each).
     vectors = vectors.reshape(n, -1, dim)
-    out = lax.psum_scatter(vectors, axis_name, scatter_dimension=0, tiled=False)
+    out = collectives.psum_scatter(
+        vectors, axis_name, scatter_dimension=0, tiled=False
+    )
     # Fail-loud OOV: an id owned by NO shard summed to zeros above; surface
     # it as NaN to match gather_rows' single-device contract.
     out = jnp.where(bad[:, None], jnp.nan, out)

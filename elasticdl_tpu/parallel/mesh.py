@@ -67,6 +67,58 @@ def create_mesh(
     return Mesh(arr, (axis_name, EMBED_AXIS))
 
 
+def dp_factorization(
+    mesh: Mesh, axis_name: str = DATA_AXIS, local_size: int = 0
+) -> tuple:
+    """Factor ``axis_name``'s positions into ``(n_host, n_local)`` for
+    the hierarchical collective route (parallel/collectives.py).
+
+    ``local_size > 0`` pins the local fan-in explicitly — the CPU
+    harness's way to emulate a multi-host grouping on fake devices, and
+    an operator override for exotic device orders.  It must divide the
+    axis size.
+
+    ``local_size == 0`` derives the grouping from the mesh itself: the
+    devices along the axis group by ``process_index``, and the
+    factorization is real exactly when those groups are contiguous and
+    equal-sized (how ``jax.devices()`` orders every multi-process world
+    — each process's devices are contiguous).  Anything else — a single
+    host, a 1-device-per-process world, ragged groups — returns the
+    trivial ``(1, n)``: no hierarchy to exploit, callers fall back to
+    flat collectives.
+    """
+    axis_dim = list(mesh.axis_names).index(axis_name)
+    devs = np.moveaxis(mesh.devices, axis_dim, 0)
+    n = devs.shape[0]
+    if local_size:
+        if n % local_size:
+            raise ValueError(
+                f"collective_local_size {local_size} does not divide the "
+                f"{axis_name!r} axis size {n}"
+            )
+        return n // local_size, local_size
+    # One process id per axis position (a position spanning processes —
+    # possible only on multi-axis meshes — breaks the grouping).
+    procs = []
+    for i in range(n):
+        owners = {d.process_index for d in np.atleast_1d(devs[i]).flat}
+        if len(owners) != 1:
+            return 1, n
+        procs.append(owners.pop())
+    runs = []  # contiguous (process, length) runs along the axis
+    for p in procs:
+        if runs and runs[-1][0] == p:
+            runs[-1][1] += 1
+        else:
+            runs.append([p, 1])
+    lengths = {length for _, length in runs}
+    if len(runs) <= 1 or len(lengths) != 1:
+        return 1, n
+    if len({p for p, _ in runs}) != len(runs):
+        return 1, n  # a process re-appears non-contiguously
+    return len(runs), lengths.pop()
+
+
 class MeshManager:
     """Owns the current mesh and re-forms it on membership changes.
 

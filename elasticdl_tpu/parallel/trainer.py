@@ -42,6 +42,7 @@ from elasticdl_tpu.common.config import DistributionStrategy, JobConfig
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.metrics import HIST_PREFIX
 from elasticdl_tpu.models.spec import EmbeddingTableSpec, ModelSpec
+from elasticdl_tpu.parallel import collectives as coll
 logger = get_logger("trainer")
 from elasticdl_tpu.ops.embedding import (
     ParallelContext,
@@ -50,7 +51,7 @@ from elasticdl_tpu.ops.embedding import (
     table_shape,
 )
 
-from elasticdl_tpu.common.jax_compat import axis_size, jit_donating, shard_map
+from elasticdl_tpu.common.jax_compat import jit_donating, shard_map
 
 
 class TrainState(struct.PyTreeNode):
@@ -217,7 +218,7 @@ def opt_state_partition_specs(
     )
 
 
-def _tree_psum_except(tree: Any, skip_paths, axes, skip_axes):
+def _tree_psum_except(tree: Any, skip_paths, axes, skip_axes, topo=None):
     """psum ``tree`` over ``axes``, except leaves at ``skip_paths`` which
     psum over ``skip_axes`` only (empty = left alone).
 
@@ -226,12 +227,13 @@ def _tree_psum_except(tree: Any, skip_paths, axes, skip_axes):
     axis, so on a hierarchical mesh they still need the data-parallel axes'
     contribution (each dp replica saw different examples) — but psum'ing
     them over the embedding axis too would multiply the gradient by its
-    size."""
+    size.  ``topo`` routes big dense leaves over the graftreduce
+    hierarchical path (parallel/collectives.py)."""
 
     def maybe_psum(path, leaf):
         if _path_keys(path) in skip_paths:
-            return lax.psum(leaf, skip_axes) if skip_axes else leaf
-        return lax.psum(leaf, axes)
+            return coll.psum(leaf, skip_axes, topo) if skip_axes else leaf
+        return coll.psum(leaf, axes, topo)
 
     return jax.tree_util.tree_map_with_path(maybe_psum, tree)
 
@@ -379,9 +381,110 @@ class Trainer:
           dp axis and the sequence over the inner ICI axis — data
           parallelism across hosts (DCN sees only the grad psum) with the
           ring attention's ppermutes confined to ICI within a slice.
+
+        The graftreduce topology (r15) re-resolves here too: the outer
+        axis's (host, local) factorization is a property of THIS mesh, so
+        every elastic reform re-derives it, and the subgroup mask resets
+        to all-active (contributor count is mesh-shaped).
         """
         self.batch_axes = tuple(mesh.axis_names)
         self.axis_name = mesh.axis_names[-1]  # embedding/sequence axis
+        self.collective = coll.resolve_topology(
+            mesh,
+            self.batch_axes,
+            mode=getattr(self.config, "collective", coll.AUTO),
+            local_size=int(getattr(self.config, "collective_local_size", 0)),
+            min_elems=int(
+                getattr(self.config, "collective_min_elems", coll.DEFAULT_MIN_ELEMS)
+            ),
+        )
+        # Subgroup-mask contributors are EXAMPLE shards, never sequence
+        # slices: a data-parallel model (batch_shard_dim=0) shards
+        # examples over every axis, so every position is a contributor; a
+        # sequence-parallel model shards examples over the OUTER axes
+        # only — its inner-axis slices hold pieces of the SAME examples,
+        # and excluding one slice of an example would train on a tensor
+        # no dataset produced.  On a 1-D sequence-parallel mesh there is
+        # no example sharding at all: one contributor, exclusion
+        # unsupported (the worker's gate self-disables at n <= 1).
+        self.contributor_axes = (
+            self.batch_axes
+            if self.spec.batch_shard_dim == 0
+            else self.batch_axes[:-1]
+        )
+        self._active_np = np.ones(
+            coll.contributor_count(mesh, self.contributor_axes), np.float32
+        )
+        self._active_dev = None
+
+    # ---- graftreduce subgroup participation (r15) ----
+
+    def num_contributors(self) -> int:
+        """Subgroup-mask slots: one per EXAMPLE shard of this mesh
+        (row-major over ``contributor_axes``) — the worker's collective
+        gate addresses exclusions by this index."""
+        return int(self._active_np.size)
+
+    def active_contributors(self) -> np.ndarray:
+        """The current 0/1 participation mask (host copy)."""
+        return np.array(self._active_np)
+
+    def set_active_contributors(self, active=None) -> None:
+        """Set the subgroup mask for subsequent train steps.  ``None``
+        restores all-active.  The mask is a traced INPUT to the jitted
+        step, so this never recompiles — the whole point of in-collective
+        exclusion is that it costs data movement, not a recompile (pinned
+        by test).  All-zero masks are rejected: a collective over an
+        empty subgroup has no mean to renormalize."""
+        n = self.num_contributors()
+        if active is None:
+            mask = np.ones(n, np.float32)
+        else:
+            mask = np.asarray(active, np.float32).reshape(-1)
+            if mask.size != n:
+                raise ValueError(
+                    f"active mask has {mask.size} slots, mesh has {n} "
+                    "contributors"
+                )
+            if not mask.any():
+                raise ValueError("cannot exclude every contributor")
+        if np.array_equal(mask, self._active_np):
+            return
+        self._active_np = mask
+        self._active_dev = None
+
+    def _active_device(self):
+        """The mask as a replicated device array (built lazily, cached
+        until the mask or mesh changes — the steady state costs one
+        reference read per step)."""
+        if self._active_dev is None:
+            sh = NamedSharding(self.mesh, P())
+            self._active_dev = jax.tree.leaves(
+                self._place_global(self._active_np, sh)
+            )[0]
+        return self._active_dev
+
+    def collective_bytes_per_step(self, state: TrainState) -> Dict[str, int]:
+        """Analytic per-replica inter-host bytes of one step's dense-grad
+        all-reduce under this mesh's resolved topology vs the flat route
+        (collectives.interhost_bytes_per_step's model; the live
+        ``edl_collective_interhost_bytes_total`` counter advances by
+        ``resolved`` per step)."""
+        table_paths = (
+            {t.path for t in self.spec.embedding_tables}
+            if self.sharded_embeddings
+            else set()
+        )
+        sizes = []
+        for path, leaf in jax.tree_util.tree_flatten_with_path(state.params)[0]:
+            if _path_keys(path) in table_paths:
+                continue
+            sizes.append(coll.leaf_elems(leaf))
+        n = coll.contributor_count(self.mesh, self.batch_axes)
+        return {
+            "flat": coll.interhost_bytes_per_step(sizes, n, None),
+            "resolved": coll.interhost_bytes_per_step(sizes, n, self.collective),
+        }
 
     def _make_ctx(self) -> ParallelContext:
         # Resolve "auto" against the MESH's platform (not the default
@@ -1109,6 +1212,7 @@ class Trainer:
             opt_shard=self._opt_plan,
             opt_shard_axis=self._opt_shard_axis(),
             donate=bool(getattr(self.config, "donate_train_state", True)),
+            collective=self.collective,
         )
 
     def train_step(self, state: TrainState, batch: Any):
@@ -1117,7 +1221,7 @@ class Trainer:
             host_keys=tuple(sorted(self.spec.host_io)),
             **self._train_build_kwargs(),
         )
-        return self._train_step(state, batch)
+        return self._train_step(state, batch, self._active_device())
 
     def shard_stacked_batch(self, stacked: Any) -> Any:
         """Place a HOST batch of stacked minibatches ([T, mb, ...] per leaf)
@@ -1170,7 +1274,7 @@ class Trainer:
             self._train_steps, build_train_step, stacked, host_keys=(),
             **self._train_build_kwargs(),
         )
-        return self._train_step(state, stacked)
+        return self._train_step(state, stacked, self._active_device())
 
     def eval_step(self, state: TrainState, batch: Any) -> Dict[str, jax.Array]:
         self._eval_step = self._structured(
@@ -1206,11 +1310,29 @@ def build_train_step(
     opt_shard: Any = None,
     opt_shard_axis: Optional[str] = None,
     donate: bool = True,
+    collective: Any = None,
 ) -> Callable:
-    """The jitted train step.  With ``host_keys`` (host-tier tables), the
-    step ALSO differentiates with respect to those injected batch arrays and
-    returns their cotangents as a third output, batch-sharded — the
-    device-side half of the pull/step/push cycle (Trainer.run_train_step).
+    """The jitted train step ``(state, batch, active) -> ...``.  With
+    ``host_keys`` (host-tier tables), the step ALSO differentiates with
+    respect to those injected batch arrays and returns their cotangents as
+    a third output, batch-sharded — the device-side half of the
+    pull/step/push cycle (Trainer.run_train_step).
+
+    ``active`` is the graftreduce subgroup mask (r15): a replicated
+    ``[n_contributors]`` float32 vector of 0/1 participation weights, one
+    per data-parallel shard.  Every contribution — the loss term, and via
+    the chain rule every dense AND sparse gradient — scales by this
+    shard's weight before any reduction, and every mean divides by the
+    ACTIVE count (``sum/|G'|``), so an excluded straggler's shard drops
+    out exactly and the survivors' math renormalizes.  With the all-ones
+    default the spelling is bit-identical to the pre-r15 step (×1.0 is
+    exact; ``psum`` of ones is exactly ``n``).  The mask is a traced
+    input: changing the excluded set never recompiles.
+
+    ``collective`` is the resolved graftreduce topology
+    (collectives.CollectiveTopology or None): big dense-grad reductions
+    route hierarchically (intra-host reduce-scatter, inter-host residue
+    psum, local gather), scalars stay flat.
 
     ``batch_axes`` lists every mesh axis the batch shards over (defaults to
     just the embedding axis — the 1-D mesh).  Reductions of loss/metrics/
@@ -1265,10 +1387,10 @@ def build_train_step(
                 if not isinstance(entry, _OptShard):
                     # Sharded-table grad: already summed within the
                     # embedding axis by the collective transpose.
-                    return lax.psum(g, dcn_axes) if dcn_axes else g
+                    return coll.psum(g, dcn_axes, collective) if dcn_axes else g
                 if other_axes:
-                    g = lax.psum(g, other_axes)
-                return lax.psum_scatter(
+                    g = coll.psum(g, other_axes, collective)
+                return coll.psum_scatter(
                     _pad_flat(g, entry), shard_axis,
                     scatter_dimension=0, tiled=True,
                 )
@@ -1307,16 +1429,35 @@ def build_train_step(
     wants_mask = "mask" in inspect.signature(spec.loss).parameters
     wants_metric_mask = "mask" in inspect.signature(spec.metrics).parameters
 
-    def local_step(state: TrainState, batch):
-        n = 1
-        for a in axes:
-            n *= axis_size(a)
+    # Exclusion slots are EXAMPLE shards (Trainer.contributor_axes): all
+    # axes for data-parallel models, the outer axes for sequence-parallel
+    # ones — an inner-axis sequence slice shares its examples with its
+    # row and must never be excluded alone.
+    contrib_axes = tuple(axes) if spec.batch_shard_dim == 0 else tuple(axes[:-1])
+
+    def local_step(state: TrainState, batch, active):
+        # This shard's 0/1 subgroup weight (graftreduce r15): scales the
+        # loss BEFORE autodiff, so every gradient — dense psum'd, table
+        # transpose-summed, host cotangent — carries the exclusion via
+        # the chain rule; no per-leaf masking can drift from the loss.
+        # Constant per contributor, so sequence-parallel slices of one
+        # example row scale uniformly; psum over ALL axes then counts
+        # each contributor once per inner slice in both numerator and
+        # denominator — the renormalization cancels exactly.
+        w = (
+            coll.contributor_weight(active, contrib_axes)
+            if contrib_axes
+            else active[0]  # SP 1-D mesh: one contributor, always active
+        )
+        n_active = jnp.maximum(coll.psum(w, axes), 1.0)
         batch = dict(batch)
         mask = batch.pop("__mask__", None) if wants_mask else None
         host_in = {k: batch.pop(k) for k in host_keys}
         if mask is not None:
-            count = jnp.sum(mask.astype(jnp.float32))
-            total = jnp.maximum(lax.psum(count, axes), 1e-12)
+            # Real-example count of THIS shard, zeroed when excluded: the
+            # renormalized total is the active shards' real examples.
+            count = jnp.sum(mask.astype(jnp.float32)) * w
+            total = jnp.maximum(coll.psum(count, axes), 1e-12)
 
         def loss_fn(params, host_embs):
             merged = dict(batch)
@@ -1326,16 +1467,18 @@ def build_train_step(
                 # count/total are constants w.r.t. params; the psum above
                 # traces fine under grad.
                 return spec.loss(out, merged, mask=mask) * count / total, out
-            return spec.loss(out, merged) / n, out
+            return spec.loss(out, merged) * w / n_active, out
 
         (loss, out), (grads, host_grads) = jax.value_and_grad(
             loss_fn, argnums=(0, 1), has_aux=True
         )(state.params, host_in)
-        loss = lax.psum(loss, axes)
+        loss = coll.psum(loss, axes)
         if opt_shard is not None:
             params, opt_state = sharded_update(state, grads)
         else:
-            grads = _tree_psum_except(grads, grad_skip, axes, dcn_axes)
+            grads = _tree_psum_except(
+                grads, grad_skip, axes, dcn_axes, collective
+            )
             updates, opt_state = spec.optimizer.update(
                 grads, state.opt_state, state.params
             )
@@ -1347,13 +1490,13 @@ def build_train_step(
         if mask is not None and wants_metric_mask:
             raw = spec.metrics(out, batch, mask=mask)
             metrics = {
-                k: lax.psum(v * count, axes) / total
+                k: coll.psum(v * count, axes) / total
                 for k, v in raw.items()
                 if not k.startswith(HIST_PREFIX)
             }
         else:
             metrics = {
-                k: lax.pmean(v, axes)
+                k: coll.psum(v * w, axes) / n_active
                 for k, v in spec.metrics(out, batch).items()
                 if not k.startswith(HIST_PREFIX)
             }
@@ -1369,8 +1512,13 @@ def build_train_step(
         if host_keys:
             raise ValueError("scan_steps is incompatible with host-tier tables")
 
-        def local_scan(state: TrainState, batches):
-            return lax.scan(local_step, state, batches)
+        def local_scan(state: TrainState, batches, active):
+            # The mask is scan-invariant: one exclusion set per task
+            # dispatch (the worker's gate runs at the task boundary).
+            def body(carry, one):
+                return local_step(carry, one, active)
+
+            return lax.scan(body, state, batches)
 
         one_step_specs = batch_specs if batch_specs is not None else P(axis)
         stacked_specs = jax.tree.map(
@@ -1381,7 +1529,7 @@ def build_train_step(
         mapped = shard_map(
             local_scan,
             mesh=mesh,
-            in_specs=(state_specs, stacked_specs),
+            in_specs=(state_specs, stacked_specs, P()),
             out_specs=(state_specs, P()),
             check_vma=False,
         )
@@ -1396,7 +1544,11 @@ def build_train_step(
     mapped = shard_map(
         local_step,
         mesh=mesh,
-        in_specs=(state_specs, batch_specs if batch_specs is not None else P(axis)),
+        in_specs=(
+            state_specs,
+            batch_specs if batch_specs is not None else P(axis),
+            P(),
+        ),
         out_specs=out_specs,
         check_vma=False,
     )
@@ -1471,11 +1623,15 @@ def build_eval_step(
         if mask is not None and wants_mask:
             metrics = spec.metrics(out, batch, mask=mask)
             count = jnp.sum(mask.astype(jnp.float32))
-            total = jnp.maximum(lax.psum(count, axes), 1e-12)
+            total = jnp.maximum(coll.psum(count, axes), 1e-12)
             return {
-                k: lax.psum(v * count, axes) / total for k, v in metrics.items()
+                k: coll.psum(v * count, axes) / total
+                for k, v in metrics.items()
             }
-        return {k: lax.pmean(v, axes) for k, v in spec.metrics(out, batch).items()}
+        return {
+            k: coll.pmean(v, axes)
+            for k, v in spec.metrics(out, batch).items()
+        }
 
     if scan_steps:
         # Stacked [T, ...] batches, all T eval steps in one lax.scan — the

@@ -295,6 +295,37 @@ class Worker:
         if config.chaos:
             chaos.configure(config.chaos)
         chaos.set_context(worker_id=worker_id, rank=self._rank)
+        # graftreduce in-step deadline gate (r15, _collective_gate): each
+        # dp shard's host-side contribution crosses the gate before a
+        # training task dispatches; one that stalls past
+        # --collective_deadline_ms is EXCLUDED from the task's
+        # collectives (subgroup mask -> trainer.set_active_contributors)
+        # instead of holding every other shard.  All state below is
+        # task-loop-thread-only (the daemon crossing threads run nothing
+        # but the chaos hook crossing and an Event.set); the counters
+        # are plain ints read by the heartbeat on the same thread.
+        self._collective_pending: Dict[int, Any] = {}  # shard -> stalled crossing
+        self._collective_consec: Dict[int, int] = {}  # consecutive exclusions
+        self._collective_skips = 0  # cumulative (task, shard) exclusions
+        self._g_coll_skips = self.gauges.counter(
+            "edl_collective_skip_total",
+            "in-collective straggler exclusions (task x shard) charged by "
+            "the r15 in-step deadline gate",
+        )
+        self._g_coll_subgroup = self.gauges.gauge(
+            "edl_collective_subgroup_size",
+            "contributors the current training collectives reduce over "
+            "(world size minus in-step exclusions)",
+        )
+        self._g_coll_bytes = self.gauges.counter(
+            "edl_collective_interhost_bytes_total",
+            "analytic per-replica inter-host bytes of the dense-grad "
+            "all-reduce (collectives.interhost_bytes_per_step's model)",
+        )
+        # Analytic inter-host bytes per step under the resolved topology;
+        # computed lazily at the first dispatch (needs the placed params)
+        # and invalidated per mesh re-formation.
+        self._collective_step_bytes: Optional[int] = None
 
         if config.checkpoint_dir:
             self._ckpt = CheckpointManager(
@@ -502,6 +533,14 @@ class Worker:
                 self.trainer.host_state(self.state)
             )
         self.state = restored
+        # graftreduce (r15): the mesh changed, so the contributor set and
+        # the analytic inter-host bytes/step change with it.  Stalled
+        # contributions of the OLD mesh are dropped (their futures run
+        # out harmlessly on the gate pool) and the mask is all-active
+        # again (trainer._adopt_mesh_axes already reset it).
+        self._collective_pending.clear()
+        self._collective_consec.clear()
+        self._collective_step_bytes = None
 
     def _restore_checkpoint(self, state_like, step: Optional[int] = None):
         """Restore a checkpoint step into the live mesh AND optimizer
@@ -630,6 +669,12 @@ class Worker:
                 "edl_gang_dispatched",
                 "gang-boundary arrivals (lockstep entries begun)",
             ).set(float(self._gang_dispatched))
+        if self.trainer is not None:
+            # Current subgroup size from the trainer's live mask (reads
+            # correctly even when the gate never armed: all-active).
+            self._g_coll_subgroup.set(
+                float(self.trainer.active_contributors().sum())
+            )
         for name, secs in self.phases.snapshot().items():
             g.gauge(
                 "edl_phase_seconds_total",
@@ -684,6 +729,12 @@ class Worker:
         # master's lockstep task log withholds collective tasks until every
         # member confirms the current topology (see RendezvousServer).
         hb = {"worker_id": self.worker_id, "version": self._membership_version}
+        if self._collective_skips:
+            # Cumulative in-collective exclusions (r15 gate): the master
+            # banks the newest value per worker — the same bounded-skip
+            # ledger the r13 boundary deadline charges (JobStatus
+            # ``collective_skips``).
+            hb["collective_skips"] = self._collective_skips
         if self._group_mode:
             # Gang-boundary arrival for the deadline-bounded boundary
             # (r13): entries whose dispatch this rank has BEGUN (see
@@ -1168,6 +1219,153 @@ class Worker:
         # can have leftover records.
         return HostPrep(total, n_full, stacked, parts[-1][3])
 
+    def _gather_contribution(self, shard: int) -> None:
+        """One dp shard's contribution crossing the collective gate.  On
+        this harness the crossing is the graftchaos hook (the r13 stance:
+        the injector is the supply side of stragglers the gate is the
+        demand side for); a real fleet would await the shard's host-side
+        inputs here (its PS row pull, its ingest chunk).  Runs on a gate
+        thread when the in-step deadline is armed — a stalled crossing
+        must stall ONE shard, never the dispatch."""
+        chaos.hook(
+            "worker:collective",
+            rank=self._rank,
+            step=self._steps_dispatched,
+            shard=shard,
+        )
+
+    def _start_crossing(self, shard: int) -> threading.Event:
+        """Run one shard's gate crossing on a DAEMON thread, signalling
+        the returned event on completion.  Daemon deliberately (not an
+        executor): a crossing wedged in a long stall must never block
+        interpreter exit at job end — the severed straggler dies with
+        the process, exactly the r13 teardown stance."""
+        done = threading.Event()
+
+        def _cross():
+            try:
+                self._gather_contribution(shard)
+            finally:
+                done.set()
+
+        threading.Thread(
+            target=_cross, name=f"edl-collgate-{shard}", daemon=True
+        ).start()
+        return done
+
+    # hot-path: the gate's wait is the in-step deadline itself, accounted
+    # under the collective_gate phase boundary
+    def _collective_gate(self, task: Task) -> None:
+        """graftreduce in-step straggler deadline (r15).
+
+        Every dp shard's host-side contribution must cross the gate
+        before the task's steps dispatch.  Deadline off (the default):
+        the crossings run inline — a stalled contributor blocks the
+        dispatch, the pre-r15 behavior (and the baseline the collective
+        bench measures against).  Deadline on: crossings run on the gate
+        pool, and a shard that misses ``--collective_deadline_ms`` is
+        EXCLUDED — its weight in the subgroup mask drops to 0, the
+        task's collectives renormalize over the survivors
+        (``sum/|G'|``; trainer.set_active_contributors, a traced input,
+        so no recompile), ``edl_collective_skip_total`` and a
+        ``collective:exclude`` instant record the skip, and the
+        cumulative count rides the heartbeat into the master's
+        accounting.  A still-stalled shard stays excluded on later tasks
+        WITHOUT re-submitting (its crossing is still in flight); when
+        the crossing completes the shard re-joins (``collective:restore``).
+
+        Bounded skip accounting (the r13 stance, same budget knob): a
+        shard excluded more than ``--gang_skip_budget`` CONSECUTIVE
+        tasks is waited out instead — a permanently dead contributor
+        must surface as a visible stall, never as silently untrained
+        data forever.
+
+        Single-process meshes only: the mask is a replicated input, and
+        every participant of a multi-process collective must dispatch
+        the same mask — coordinating that across a gang needs a master
+        round-trip per entry, so multi-process stragglers stay with the
+        r13 task-boundary deadline (docs/robustness.md lays out the two
+        layers)."""
+        n = self.trainer.num_contributors()
+        deadline_s = self.config.collective_deadline_ms / 1e3
+        if deadline_s <= 0 or n <= 1 or self._group_mode:
+            if chaos.enabled():
+                for shard in range(n):
+                    self._gather_contribution(shard)
+            return
+        if not chaos.enabled() and not self._collective_pending:
+            # On this harness the chaos hook is the only crossing body
+            # (_gather_contribution's docstring) — unarmed, nothing can
+            # stall, so skip the per-shard thread spawn entirely.  The
+            # mask invariant (exclusions == pending keys, rebuilt every
+            # armed pass) means empty pending implies all-active already.
+            self._g_coll_subgroup.set(float(n))
+            return
+        # Re-admit contributors whose stalled crossing finally finished.
+        for shard, done in list(self._collective_pending.items()):
+            if done.is_set():
+                self._collective_pending.pop(shard)
+                self._collective_consec.pop(shard, None)
+                trace.instant(
+                    "collective:restore", cat="collective",
+                    shard=shard, task=task.task_id,
+                )
+        crossings = {
+            shard: self._start_crossing(shard)
+            for shard in range(n)
+            if shard not in self._collective_pending
+        }
+        end = time.monotonic() + deadline_s
+        with self.phases.phase("collective_gate"):
+            for shard, done in crossings.items():
+                if not done.wait(timeout=max(0.0, end - time.monotonic())):
+                    self._collective_pending[shard] = done
+            # Budget escalation: a shard past its consecutive-skip budget
+            # is waited out (the stall becomes visible dispatch time in
+            # this phase, exactly where a pre-r15 stall would land).
+            budget = max(0, self.config.gang_skip_budget)
+            for shard, done in list(self._collective_pending.items()):
+                if self._collective_consec.get(shard, 0) < budget and (
+                    len(self._collective_pending) < n
+                ):
+                    continue
+                logger.warning(
+                    "collective gate: shard %d exceeded %d consecutive "
+                    "in-step skips (or no quorum remains); waiting it out",
+                    shard, budget,
+                )
+                done.wait()  # accounted: inside the collective_gate phase
+                self._collective_pending.pop(shard)
+                self._collective_consec.pop(shard, None)
+                trace.instant(
+                    "collective:restore", cat="collective",
+                    shard=shard, task=task.task_id, waited=True,
+                )
+        excluded = sorted(self._collective_pending)
+        mask = np.ones(n, np.float32)
+        for shard in excluded:
+            mask[shard] = 0.0
+            self._collective_consec[shard] = (
+                self._collective_consec.get(shard, 0) + 1
+            )
+            self._collective_skips += 1
+            self._g_coll_skips.inc()
+            trace.instant(
+                "collective:exclude", cat="collective",
+                shard=shard, task=task.task_id,
+                deadline_ms=self.config.collective_deadline_ms,
+                consecutive=self._collective_consec[shard],
+            )
+        self.trainer.set_active_contributors(mask)
+        self._g_coll_subgroup.set(float(n - len(excluded)))
+        if excluded:
+            logger.warning(
+                "collective gate: task %d trains on subgroup %d/%d "
+                "(excluded shard(s) %s past %.0f ms in-step deadline)",
+                task.task_id, n - len(excluded), n, excluded,
+                self.config.collective_deadline_ms,
+            )
+
     # hot-path: THE dispatch function — every blocking transfer here shows
     # up as device idle on the remote-attached chip
     def _dispatch_training_task(
@@ -1205,6 +1403,11 @@ class Worker:
         chaos.hook(
             "worker:step", rank=self._rank, step=self._steps_dispatched
         )
+        # graftreduce (r15): every shard's contribution crosses the
+        # in-step deadline gate before the steps dispatch; a straggler
+        # past --collective_deadline_ms is excluded-and-renormalized
+        # instead of holding the collective.
+        self._collective_gate(task)
         mb = self.config.minibatch_size
         if prep is not None:
             records = None
@@ -1323,6 +1526,11 @@ class Worker:
         # the only gauge API legal on the hot path (gauge-discipline).
         self._g_examples.inc(total)
         self._g_steps.inc(n_steps)
+        if self._collective_step_bytes is None:
+            self._collective_step_bytes = (
+                self.trainer.collective_bytes_per_step(self.state)["resolved"]
+            )
+        self._g_coll_bytes.inc(n_steps * self._collective_step_bytes)
         # Start the D2H copy of the task's metrics NOW, in the background:
         # the runtime moves each value to the host as soon as its step
         # completes, so the deferred fetch in _finalize_training_metrics

@@ -1,0 +1,448 @@
+"""graftreduce (parallel/collectives.py, r15): topology factorization,
+hierarchical-vs-flat parity, subgroup exclusion renormalization (vs a
+recomputed smaller-world baseline), recompile-free mask flips, elastic
+reform with hierarchical mode on, the chaos ``point=collective`` grammar,
+and the worker's in-step deadline gate end-to-end."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from elasticdl_tpu import chaos
+from elasticdl_tpu.common import trace
+from elasticdl_tpu.common.config import JobConfig
+from elasticdl_tpu.models.spec import load_model_spec
+from elasticdl_tpu.parallel import collectives as coll
+from elasticdl_tpu.parallel.mesh import create_mesh, dp_factorization
+from elasticdl_tpu.parallel.trainer import Trainer
+
+
+@pytest.fixture(autouse=True)
+def _reset_chaos_and_trace():
+    yield
+    chaos.configure("")
+    chaos.set_context(rank=None, worker_id=None, shard=None)
+    trace.configure(enabled=False)
+    trace.default().clear()
+
+
+def _mnist_spec():
+    return load_model_spec(
+        "elasticdl_tpu.models", "mnist.model_spec", compute_dtype="float32"
+    )
+
+
+def _mnist_batch(n, seed=3):
+    rng = np.random.default_rng(seed)
+    return {
+        "images": rng.uniform(size=(n, 28, 28, 1)).astype(np.float32),
+        "labels": rng.integers(0, 10, (n,)).astype(np.int32),
+    }
+
+
+def _trainer(spec, n_dev, **cfg):
+    config = JobConfig(**cfg)
+    return Trainer(spec, config, create_mesh(jax.devices(), num_devices=n_dev))
+
+
+def _max_param_diff(a, b):
+    return max(
+        float(np.max(np.abs(np.asarray(x) - np.asarray(y)))) if x.size else 0.0
+        for x, y in zip(
+            jax.tree.leaves(jax.device_get(a.params)),
+            jax.tree.leaves(jax.device_get(b.params)),
+        )
+    )
+
+
+# ---------------------------------------------------------------------------
+# topology factorization + resolution
+# ---------------------------------------------------------------------------
+
+class TestFactorization:
+    def test_single_host_is_trivial(self, devices):
+        mesh = create_mesh(devices, num_devices=4)
+        # All 8 fake devices share one process: no real grouping.
+        assert dp_factorization(mesh) == (1, 4)
+
+    def test_explicit_local_size(self, devices):
+        mesh = create_mesh(devices, num_devices=8)
+        assert dp_factorization(mesh, local_size=2) == (4, 2)
+        assert dp_factorization(mesh, local_size=4) == (2, 4)
+
+    def test_non_dividing_local_size_raises(self, devices):
+        mesh = create_mesh(devices, num_devices=4)
+        with pytest.raises(ValueError, match="does not divide"):
+            dp_factorization(mesh, local_size=3)
+
+    def test_resolve_flat_is_none(self, devices):
+        mesh = create_mesh(devices, num_devices=4)
+        assert coll.resolve_topology(mesh, ("dp",), mode="flat") is None
+
+    def test_resolve_auto_single_host_is_flat(self, devices):
+        mesh = create_mesh(devices, num_devices=4)
+        assert coll.resolve_topology(mesh, ("dp",), mode="auto") is None
+
+    def test_resolve_hierarchical_without_factorization_demotes(self, devices):
+        # Explicit hierarchical with no grouping and no override: flat
+        # fallback (availability beats layout — the elastic stance).
+        mesh = create_mesh(devices, num_devices=4)
+        assert coll.resolve_topology(mesh, ("dp",), mode="hierarchical") is None
+
+    def test_resolve_with_override(self, devices):
+        mesh = create_mesh(devices, num_devices=4)
+        topo = coll.resolve_topology(
+            mesh, ("dp",), mode="hierarchical", local_size=2, min_elems=1
+        )
+        assert topo is not None and topo.hierarchical
+        assert (topo.n_host, topo.n_local) == (2, 2)
+        assert topo.local_groups == [[0, 1], [2, 3]]
+        assert topo.cross_groups == [[0, 2], [1, 3]]
+
+    def test_interhost_bytes_model(self):
+        topo = coll.CollectiveTopology("dp", n_host=2, n_local=4, min_elems=64)
+        flat = coll.interhost_bytes_per_step([4096], 8, None)
+        hier = coll.interhost_bytes_per_step([4096], 8, topo)
+        # The inter-host residue is 1/n_local of the leaf: the cut the
+        # hierarchy exists for.
+        assert hier < flat / 3
+        # Below min_elems both routes price flat.
+        assert coll.interhost_bytes_per_step([16], 8, topo) == (
+            coll.interhost_bytes_per_step([16], 8, None)
+        )
+        assert coll.interhost_bytes_per_step([4096], 1, topo) == 0
+
+
+# ---------------------------------------------------------------------------
+# hierarchical parity (flat vs 3-phase grouped reduce)
+# ---------------------------------------------------------------------------
+
+#: Float32 reduction-order tolerance (the r11 psum-vs-psum_scatter stance):
+#: the hierarchical route sums in a different association order, so params
+#: diverge by a few ulps per step, never more.
+ULP_TOL = 5e-6
+
+
+def test_hierarchical_train_parity(devices):
+    spec = _mnist_spec()
+    tf_ = _trainer(spec, 4, collective="flat")
+    th = _trainer(
+        spec, 4, collective="hierarchical", collective_local_size=2,
+        collective_min_elems=1,
+    )
+    assert th.collective is not None and th.collective.hierarchical
+    sf = tf_.init_state(jax.random.key(0))
+    sh = th.init_state(jax.random.key(0))
+    batch = _mnist_batch(64)
+    for _ in range(3):
+        sf, mf = tf_.train_step(sf, tf_.shard_batch(batch))
+        sh, mh = th.train_step(sh, th.shard_batch(batch))
+    assert _max_param_diff(sf, sh) < ULP_TOL
+    assert abs(float(mf["loss"]) - float(mh["loss"])) < ULP_TOL
+
+
+def test_hierarchical_with_sharded_optimizer(devices):
+    # Composition with the r11 path: reduce-scatter grads + hierarchical
+    # metric/table reductions in one step, vs the flat replicated build.
+    spec = _mnist_spec()
+    tf_ = _trainer(spec, 4, collective="flat")
+    th = _trainer(
+        spec, 4, collective="hierarchical", collective_local_size=2,
+        collective_min_elems=1, optimizer_sharding="sharded",
+    )
+    sf = tf_.init_state(jax.random.key(0))
+    sh = th.init_state(jax.random.key(0))
+    batch = _mnist_batch(64)
+    for _ in range(2):
+        sf, _ = tf_.train_step(sf, tf_.shard_batch(batch))
+        sh, _ = th.train_step(sh, th.shard_batch(batch))
+    assert _max_param_diff(sf, sh) < ULP_TOL
+
+
+def test_hierarchical_reform_2_4_2_preserves_moments(devices):
+    # Elastic resize with hierarchical mode on: the canonical host bridge
+    # is collective-mode-agnostic — moments survive 2->4->2 bit-exact
+    # (r11's guarantee, now under the r15 topology), and the topology
+    # re-resolves per mesh (4 devices factor 2x2; 2 devices cannot).
+    spec = _mnist_spec()
+    t = _trainer(
+        spec, 4, collective="hierarchical", collective_local_size=2,
+        collective_min_elems=1, optimizer_sharding="sharded",
+    )
+    state = t.init_state(jax.random.key(0))
+    state, _ = t.train_step(state, t.shard_batch(_mnist_batch(64)))
+    h0 = t.host_state(state)
+    for size in (2, 4, 2):
+        t.set_mesh(create_mesh(jax.devices(), num_devices=size))
+        state = t.shard_state(h0)
+        if size == 4:
+            assert t.collective is not None and t.collective.hierarchical
+        else:
+            # local_size=2 over a 2-wide axis: n_host degenerates to 1.
+            assert t.collective is None
+        # The mask resets to the new mesh's contributor count.
+        assert t.num_contributors() == size
+        h1 = t.host_state(state)
+        assert all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(jax.tree.leaves(h0), jax.tree.leaves(h1))
+        )
+    state, m = t.train_step(state, t.shard_batch(_mnist_batch(64)))
+    assert np.isfinite(float(m["loss"]))
+
+
+# ---------------------------------------------------------------------------
+# subgroup exclusion: renormalization numerics + recompile-free mask
+# ---------------------------------------------------------------------------
+
+def test_excluded_rank_matches_smaller_world(devices):
+    # sum/|G'| renormalization: a 4-shard step excluding shard 3 must
+    # train exactly like a 1-device step over shards 0..2's examples
+    # (float32 reduction-order tolerance, the r11 parity stance).
+    spec = _mnist_spec()
+    t4 = _trainer(spec, 4)
+    t1 = _trainer(spec, 1)
+    s4 = t4.init_state(jax.random.key(0))
+    s1 = t1.init_state(jax.random.key(0))
+    batch = _mnist_batch(64)
+    t4.set_active_contributors([1, 1, 1, 0])
+    s4, m4 = t4.train_step(s4, t4.shard_batch(batch))
+    sub = {k: v[:48] for k, v in batch.items()}
+    s1, m1 = t1.train_step(s1, t1.shard_batch(sub))
+    assert _max_param_diff(s4, s1) < ULP_TOL
+    assert abs(float(m4["loss"]) - float(m1["loss"])) < ULP_TOL
+
+
+def test_excluded_rank_with_ragged_mask(devices):
+    # Exclusion composes with the wrap-padded __mask__ weighting: the
+    # renormalized total counts only ACTIVE shards' real examples.
+    spec = _mnist_spec()
+    t4 = _trainer(spec, 4)
+    t1 = _trainer(spec, 1)
+    batch = _mnist_batch(64)
+    batch["__mask__"] = (np.arange(64) < 60).astype(np.float32)
+    t4.set_active_contributors([0, 1, 1, 1])
+    s4, m4 = t4.train_step(t4.init_state(jax.random.key(0)), t4.shard_batch(batch))
+    sub = {k: v[16:] for k, v in batch.items()}
+    s1, m1 = t1.train_step(t1.init_state(jax.random.key(0)), t1.shard_batch(sub))
+    assert _max_param_diff(s4, s1) < ULP_TOL
+
+
+def test_mask_flip_never_recompiles(devices):
+    spec = _mnist_spec()
+    t = _trainer(spec, 4)
+    state = t.init_state(jax.random.key(0))
+    batch = _mnist_batch(64)
+    state, _ = t.train_step(state, t.shard_batch(batch))
+    fn = t._train_step
+    for mask in ([1, 1, 1, 0], [0, 1, 1, 1], None, [1, 0, 1, 1]):
+        t.set_active_contributors(mask)
+        state, _ = t.train_step(state, t.shard_batch(batch))
+    assert t._train_step is fn  # same structural build
+    cache_size = getattr(fn, "_cache_size", None)
+    if cache_size is not None:  # jax version-dependent introspection
+        assert cache_size() == 1  # ONE compiled program across all masks
+
+
+def test_scan_variant_carries_mask(devices):
+    # The fused lax.scan task path applies the same exclusion as the
+    # per-step path: T scanned steps with shard 1 excluded equal T
+    # per-step calls with the same mask.
+    spec = _mnist_spec()
+    ta = _trainer(spec, 2)
+    tb = _trainer(spec, 2)
+    sa = ta.init_state(jax.random.key(0))
+    sb = tb.init_state(jax.random.key(0))
+    stacked = {
+        "images": np.stack([_mnist_batch(32, seed=s)["images"] for s in (1, 2)]),
+        "labels": np.stack([_mnist_batch(32, seed=s)["labels"] for s in (1, 2)]),
+    }
+    ta.set_active_contributors([1, 0])
+    tb.set_active_contributors([1, 0])
+    sa, _ = ta.train_scan(sa, ta.shard_stacked_batch(stacked))
+    for i in range(2):
+        one = {k: v[i] for k, v in stacked.items()}
+        sb, _ = tb.train_step(sb, tb.shard_batch(one))
+    assert _max_param_diff(sa, sb) < ULP_TOL
+
+
+def test_mask_validation(devices):
+    t = _trainer(_mnist_spec(), 4)
+    assert t.num_contributors() == 4
+    with pytest.raises(ValueError, match="slots"):
+        t.set_active_contributors([1, 1])
+    with pytest.raises(ValueError, match="every contributor"):
+        t.set_active_contributors([0, 0, 0, 0])
+    t.set_active_contributors([1, 0, 1, 1])
+    assert t.active_contributors().tolist() == [1, 0, 1, 1]
+    t.set_active_contributors(None)
+    assert t.active_contributors().tolist() == [1, 1, 1, 1]
+
+
+def test_sequence_parallel_contributors_are_example_shards(devices):
+    # A sequence-parallel model's inner-axis slices hold pieces of the
+    # SAME examples: on a 1-D mesh there is no example sharding at all,
+    # so exclusion must be unsupported (one contributor — the worker's
+    # gate self-disables), and the mask input must be inert on the step.
+    spec = load_model_spec(
+        "elasticdl_tpu.models", "transformer_lm.model_spec",
+        compute_dtype="float32", vocab=128, dim=32, n_heads=2, n_layers=1,
+        max_seq=32, seq_len=32,
+    )
+    t = Trainer(spec, JobConfig(), create_mesh(jax.devices(), num_devices=2))
+    assert spec.batch_shard_dim == 1
+    assert t.contributor_axes == ()
+    assert t.num_contributors() == 1
+    with pytest.raises(ValueError, match="every contributor"):
+        t.set_active_contributors([0])
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 128, size=(4, 33)).astype(np.int32)
+    batch = {"tokens": toks[:, :-1], "labels": toks[:, 1:]}
+    state = t.init_state(jax.random.key(0))
+    state, m = t.run_train_step(state, batch)
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_config_knobs_validate():
+    JobConfig(collective="hierarchical", collective_local_size=2).validate()
+    with pytest.raises(ValueError, match="--collective must"):
+        JobConfig(collective="ring").validate()
+    with pytest.raises(ValueError, match="collective_local_size"):
+        JobConfig(collective_local_size=-1).validate()
+    with pytest.raises(ValueError, match="collective_min_elems"):
+        JobConfig(collective_min_elems=0).validate()
+    with pytest.raises(ValueError, match="collective_deadline_ms"):
+        JobConfig(collective_deadline_ms=-1.0).validate()
+    # The config's literal mode list stays in sync with the module's.
+    assert set(coll.MODES) == {"flat", "hierarchical", "auto"}
+
+
+# ---------------------------------------------------------------------------
+# chaos grammar: point=collective + shard addressing
+# ---------------------------------------------------------------------------
+
+class TestCollectiveChaosGrammar:
+    def test_collective_stall_parses(self):
+        from elasticdl_tpu.chaos.inject import parse_plan
+
+        (f,) = parse_plan("stall:rank=0,point=collective,shard=1,ms=50")
+        assert f.point == "collective" and f.shard == 1
+
+    def test_shard_requires_collective_point(self):
+        from elasticdl_tpu.chaos.inject import ChaosError, parse_plan
+
+        with pytest.raises(ChaosError, match="shard"):
+            parse_plan("stall:point=prep,shard=1,ms=50")
+
+    def test_shard_gates_firing(self):
+        from elasticdl_tpu.chaos.inject import ChaosInjector, parse_plan
+
+        inj = ChaosInjector(
+            parse_plan("stall:point=collective,shard=1,ms=1,count=0")
+        )
+        fired = []
+        inj._apply = lambda f, p, c: fired.append(c.get("shard"))
+        inj.fire("worker:collective", {"shard": 0})
+        inj.fire("worker:collective", {"shard": 1})
+        inj.fire("worker:collective", {"shard": 2})
+        assert fired == [1]
+
+
+# ---------------------------------------------------------------------------
+# the worker's in-step deadline gate, end to end
+# ---------------------------------------------------------------------------
+
+def _run_gate_job(tmp_path, devices, chaos_plan, deadline_ms, tasks=4,
+                  skip_budget=8):
+    from elasticdl_tpu.data.reader import create_data_reader
+    from elasticdl_tpu.data.synthetic import generate
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.worker.worker import DirectMasterProxy, Worker
+
+    train = str(tmp_path / "train.rio")
+    generate("mnist", train, 32 * tasks)
+    config = JobConfig(
+        model_def="mnist.model_spec",
+        training_data=train,
+        minibatch_size=16,
+        num_minibatches_per_task=2,
+        trace=True,
+        chaos=chaos_plan,
+        collective_deadline_ms=deadline_ms,
+        gang_skip_budget=skip_budget,
+    )
+    reader = create_data_reader(train)
+    dispatcher = TaskDispatcher(reader.create_shards(32))
+    servicer = MasterServicer(dispatcher)
+    spec = load_model_spec(
+        "elasticdl_tpu.models", "mnist.model_spec", compute_dtype="float32"
+    )
+    worker = Worker(
+        config, DirectMasterProxy(servicer), reader,
+        worker_id="w0", spec=spec, devices=devices[:2],
+    )
+    result = worker.run()
+    return worker, servicer, result, tasks
+
+
+def test_gate_excludes_stalled_shard_and_completes(tmp_path, devices):
+    # Shard 1's contribution stalls 1.5 s at one gate crossing; the
+    # 100 ms in-step deadline excludes it, the job NEVER waits the stall
+    # out mid-task, every task completes exactly once, and the skip is
+    # observable in the gauges, the trace, and the master's ledger.
+    worker, servicer, result, tasks = _run_gate_job(
+        tmp_path, devices,
+        "stall:point=collective,shard=1,ms=1500,count=1",
+        deadline_ms=100.0,
+    )
+    assert result["tasks_done"] == tasks
+    status = servicer.JobStatus({})
+    assert status["duplicate_done"] == 0 and not status.get("abandoned")
+    assert worker._collective_skips >= 1
+    # The master banked the heartbeat-borne ledger.
+    assert status["collective_skips"].get("w0", 0) >= 1
+    # Gauges: cumulative skip counter + live subgroup size family exist.
+    snap = worker.gauges.snapshot()
+    assert snap["edl_collective_skip_total"]["samples"][0]["value"] >= 1
+    assert "edl_collective_subgroup_size" in snap
+    assert snap["edl_collective_interhost_bytes_total"]["samples"][0]["value"] >= 0
+    # Attributable: exclude (and, once the stall cleared, restore)
+    # instants in the worker's ring.
+    dump = servicer.DumpTrace({})
+    names = [
+        e["name"] for e in dump["processes"].get("w0", {}).get("events", [])
+    ] + [e["name"] for e in trace.default().export()]
+    assert "collective:exclude" in names
+    assert "chaos:stall" in names
+
+
+def test_gate_budget_escalates_to_waiting(tmp_path, devices):
+    # gang_skip_budget=0: no free in-step skips — the gate must WAIT the
+    # straggler out (the r13 bounded-skip stance: a dead contributor
+    # surfaces as a visible stall, never silent exclusion forever).
+    worker, servicer, result, tasks = _run_gate_job(
+        tmp_path, devices,
+        "stall:point=collective,shard=1,ms=400,count=1",
+        deadline_ms=50.0, skip_budget=0,
+    )
+    assert result["tasks_done"] == tasks
+    # The shard was never excluded past the budget: every crossing was
+    # waited out, so no task trained without it after the charge.
+    assert worker._collective_pending == {}
+    # All contributors active again at job end.
+    assert worker.trainer.active_contributors().sum() == 2
+
+
+def test_gate_off_blocks_like_pre_r15(tmp_path, devices):
+    # Deadline 0 (default): the stalled crossing blocks the dispatch —
+    # nothing is excluded, nothing is skipped.
+    worker, servicer, result, tasks = _run_gate_job(
+        tmp_path, devices,
+        "stall:point=collective,shard=1,ms=200,count=1",
+        deadline_ms=0.0,
+    )
+    assert result["tasks_done"] == tasks
+    assert worker._collective_skips == 0
+    assert servicer.JobStatus({})["collective_skips"] == {}

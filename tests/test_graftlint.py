@@ -12,6 +12,7 @@ import pytest
 
 from elasticdl_tpu.analysis import all_passes
 from elasticdl_tpu.analysis.blocking import BlockingPropagationPass
+from elasticdl_tpu.analysis.collective_shim import CollectiveShimPass
 from elasticdl_tpu.analysis.compat_shim import CompatShimPass
 from elasticdl_tpu.analysis.core import SourceFile, lint_text, run_lint, run_passes
 from elasticdl_tpu.analysis.hot_path import HotPathSyncPass
@@ -606,6 +607,74 @@ def test_compat_shim_flags_attr_spellings_but_not_in_shim_module():
         path="elasticdl_tpu/common/jax_compat.py",
     )
     assert clean == []
+
+
+# ---- collective-shim (graftreduce r15) ----
+
+COLLECTIVE_SEEDED = """
+    from jax import lax
+
+    def local_step(grads, axes):
+        loss = lax.psum(grads, axes)
+        mean = lax.pmean(grads, axes)
+        shard = lax.psum_scatter(grads, "dp", scatter_dimension=0, tiled=True)
+        return loss, mean, shard
+"""
+
+COLLECTIVE_CLEAN = """
+    from jax import lax
+    from elasticdl_tpu.parallel import collectives as coll
+
+    def local_step(grads, axes, topo):
+        loss = coll.psum(grads, axes, topo)
+        mean = coll.pmean(grads, axes, topo)
+        shard = coll.psum_scatter(grads, "dp", scatter_dimension=0, tiled=True)
+        gathered = lax.all_gather(grads, "dp")  # moves data, not a reduction
+        return loss, mean, shard, gathered
+"""
+
+
+def test_collective_shim_flags_raw_reductions():
+    findings = _lint(COLLECTIVE_SEEDED, [CollectiveShimPass()])
+    assert _rules(findings) == {"collective-shim"}
+    assert len(findings) == 3  # psum + pmean + psum_scatter
+
+
+def test_collective_shim_clean_twin():
+    assert _lint(COLLECTIVE_CLEAN, [CollectiveShimPass()]) == []
+
+
+def test_collective_shim_flags_import_alias():
+    # ``from jax.lax import psum`` would smuggle the raw spelling past
+    # the attribute check — the import itself is the finding.
+    src = """
+        from jax.lax import psum, all_gather
+
+        def f(x):
+            return psum(x, "dp"), all_gather(x, "dp")
+    """
+    findings = _lint(src, [CollectiveShimPass()])
+    assert len(findings) == 1  # all_gather stays legal
+
+
+def test_collective_shim_exempts_shim_modules():
+    src = textwrap.dedent(COLLECTIVE_SEEDED)
+    for path in (
+        "elasticdl_tpu/parallel/collectives.py",
+        "elasticdl_tpu/common/jax_compat.py",
+    ):
+        assert lint_text(src, [CollectiveShimPass()], path=path) == []
+
+
+def test_collective_shim_jax_lax_spelling():
+    src = """
+        import jax
+
+        def f(x):
+            return jax.lax.psum(x, "dp")
+    """
+    findings = _lint(src, [CollectiveShimPass()])
+    assert _rules(findings) == {"collective-shim"}
 
 
 # ---- rpc-discipline ----

@@ -185,7 +185,11 @@ def bench_config(name: str, batch_override: int = 0, measure: int = MEASURE) -> 
         # just wrote it hard-aborts in this jax build (platform.py).
         with suspend_compile_cache():
             cost = (
-                trainer._train_step.lower(state, sharded2)
+                # Third arg since r15: the graftreduce subgroup mask is a
+                # traced input of every train step.
+                trainer._train_step.lower(
+                    state, sharded2, trainer._active_device()
+                )
                 .compile()
                 .cost_analysis()
             )
@@ -369,6 +373,21 @@ def main() -> None:
         "fault path works without the full gang run",
     )
     ap.add_argument(
+        "--collective", action="store_true",
+        help="also run the graftreduce bench (tools/collective_bench.py) "
+        "after the training configs; it stamps its own COLLECT artifact — "
+        "flat-vs-hierarchical parity + step-time sweep at 2/4/8-way, the "
+        "analytic inter-host bytes cut, and the mid-collective-stall "
+        "chaos fleets (blocking vs subgroup completion)",
+    )
+    ap.add_argument(
+        "--collective-smoke", action="store_true",
+        help="run ONLY the graftreduce smoke: one worker with a 2-shard "
+        "dp mesh, one mid-collective stall — asserts the in-step deadline "
+        "gate completes the job on the subgroup (skips > 0, live-scrape "
+        "observable) with zero double-train",
+    )
+    ap.add_argument(
         "--trace-smoke", action="store_true",
         help="run ONLY the grafttrace overhead smoke: the ingest bench's "
         "--trace A/B (recorder off vs on, same workload) must land under "
@@ -403,6 +422,27 @@ def main() -> None:
         print(
             "[chaos-smoke] PASS: recovery "
             f"{result['recovery'].get('recovery_time_ms')} ms, zero "
+            "double-train", file=sys.stderr,
+        )
+        return
+    if args.collective_smoke:
+        # CPU-harness subprocess fleet (the chaos-smoke stance): the smoke
+        # measures the in-collective exclusion machinery, not the chip.
+        from tools.collective_bench import run_smoke as collective_smoke
+
+        result = collective_smoke(
+            lambda m: print(
+                f"[collective-smoke] {m}", file=sys.stderr, flush=True
+            )
+        )
+        print(json.dumps(result), flush=True)
+        if result["problems"]:
+            for p in result["problems"]:
+                print(f"[collective-smoke] FAIL: {p}", file=sys.stderr)
+            raise SystemExit(1)
+        print(
+            "[collective-smoke] PASS: subgroup completion with "
+            f"{sum(result['collective_skips'].values())} skip(s), zero "
             "double-train", file=sys.stderr,
         )
         return
@@ -475,6 +515,13 @@ def main() -> None:
         # Subprocess-fleet driven (the bench process itself stays
         # jax-free), so running it after the in-process configs is safe.
         chaos_main([])
+    if args.collective:
+        from tools.collective_bench import main as collective_main
+
+        # Subprocess-driven sweep children + subprocess worker fleets
+        # (this process never re-initializes its backend), so running it
+        # after the in-process configs is safe.
+        collective_main([])
     if args.serving:
         from tools.serving_bench import run_bench
 

@@ -39,7 +39,7 @@ if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
 DEFAULT_PATHS = ("elasticdl_tpu", "tools")
-ARTIFACT_NAME = "LINT_r14.json"
+ARTIFACT_NAME = "LINT_r15.json"
 
 
 def _changed_files(repo: str) -> Optional[List[str]]:

@@ -146,6 +146,46 @@ def _hist_stats(samples: List[dict], series_key: Tuple[Tuple[str, str], ...]):
     return total, s, q(0.5), q(0.99)
 
 
+def _scalar_sum(families: Dict[str, dict], name: str) -> Optional[float]:
+    fam = families.get(name)
+    if not fam or not fam["samples"]:
+        return None
+    return sum(s["value"] for s in fam["samples"])
+
+
+def render_collectives(
+    families: Dict[str, dict],
+    prev: Optional[Dict[str, dict]] = None,
+    dt_s: float = 0.0,
+) -> Optional[str]:
+    """One summary line for the graftreduce (r15) gauge families — skip
+    total, current subgroup size, and the inter-host bytes rate — or
+    None when the endpoint serves none of them (a PS shard, an old
+    build).  The bytes RATE needs two scrapes (``prev`` + ``dt_s``, the
+    polling mode); one-shot views show the cumulative total instead."""
+    skips = _scalar_sum(families, "edl_collective_skip_total")
+    sub = _scalar_sum(families, "edl_collective_subgroup_size")
+    total = _scalar_sum(families, "edl_collective_interhost_bytes_total")
+    if skips is None and sub is None and total is None:
+        return None
+    parts = []
+    if skips is not None:
+        parts.append(f"skips={skips:.0f}")
+    if sub is not None:
+        parts.append(f"subgroup={sub:.0f}")
+    if total is not None:
+        prev_total = (
+            _scalar_sum(prev, "edl_collective_interhost_bytes_total")
+            if prev else None
+        )
+        if prev_total is not None and dt_s > 0:
+            rate = max(total - prev_total, 0.0) / dt_s
+            parts.append(f"interhost={rate / 1e6:.2f} MB/s")
+        else:
+            parts.append(f"interhost_total={total / 1e6:.2f} MB")
+    return "collectives: " + " ".join(parts)
+
+
 def render_table(families: Dict[str, dict],
                  prefixes: Optional[List[str]] = None) -> str:
     """One aligned line per series; histograms summarize to
@@ -210,12 +250,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = ap.parse_args(argv)
     prefixes = [p for p in args.families.split(",") if p]
 
+    # Previous scrape (+ its time) for rate lines in polling mode.
+    state: dict = {"prev": None, "t": 0.0}
+
     def once() -> None:
         if args.healthz:
             body = fetch_text(args.address, "/healthz", args.timeout)
             print(json.dumps(json.loads(body), indent=None if args.json else 1))
             return
         families = fetch(args.address, args.timeout)
+        now = time.monotonic()
         if prefixes:
             families = {
                 n: f for n, f in families.items()
@@ -224,7 +268,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.json:
             print(json.dumps(families, sort_keys=True))
         else:
+            summary = render_collectives(
+                families, state["prev"],
+                now - state["t"] if state["prev"] else 0.0,
+            )
+            if summary:
+                print(summary)
             print(render_table(families))
+        state["prev"], state["t"] = families, now
 
     if args.interval <= 0:
         once()
