@@ -388,6 +388,12 @@ def run_chaos_family(args, tmp: str, log) -> dict:
         f"stall:point=collective,shard=1,step=3,"
         f"ms={int(args.stall_ms)},count=1"
     )
+    # All three fleets share one compile cache (same model, same shapes);
+    # an UNSTAMPED warmup fleet pays the XLA compiles first, so the
+    # baseline — the degradation DENOMINATOR — measures steady-state
+    # wall, not compilation (the chaos_bench cache stance, one step
+    # further: here even the baseline must be warm).
+    run_fleet(2, tmp, log, "warmup")
     fleets = {
         "baseline": run_fleet(args.tasks, tmp, log, "baseline"),
         "stall_blocking": run_fleet(
