@@ -291,7 +291,11 @@ def run_fleet(
     from elasticdl_tpu.master.main import Master
 
     mb, mb_per_task = 16, 2
-    path = os.path.join(tmp, "collective_mnist.rio")
+    # Keyed by task count: fleets of different sizes (the warmup fleet is
+    # deliberately short) must never share a dataset sized for the first
+    # caller — a 2-task file silently turns every 6-task fleet into a
+    # 2-task one.
+    path = os.path.join(tmp, f"collective_mnist_{n_tasks}.rio")
     if not os.path.exists(path):
         generate("mnist", path, mb * mb_per_task * n_tasks)
     os.environ["JAX_COMPILATION_CACHE_DIR"] = os.path.join(tmp, "jax_cache")
