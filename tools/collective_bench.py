@@ -440,8 +440,13 @@ def run_chaos_family(args, tmp: str, log) -> dict:
             ),
             "subgroup_skipped": skips >= 1,
             "subgroup_beats_blocking": subgroup_excess_ms < blocking_excess_ms,
+            # "Well under" = a 5x margin on the r13 evict-and-reform
+            # path.  The excess is a difference of ~15 s whole-fleet
+            # walls on a 2-core box whose process-spawn/scrape noise is
+            # ±2-3 s — a tighter bound would gate on weather, not on
+            # the subsystem (the r12 wall-A/B stance).
             "subgroup_well_under_r13": (
-                subgroup_excess_ms < R13_SKIP_TO_TRAINED_MS / 10
+                subgroup_excess_ms < R13_SKIP_TO_TRAINED_MS / 5
             ),
         },
     }
