@@ -1290,8 +1290,15 @@ class Worker:
         deadline_s = self.config.collective_deadline_ms / 1e3
         if deadline_s <= 0 or n <= 1 or self._group_mode:
             if chaos.enabled():
-                for shard in range(n):
-                    self._gather_contribution(shard)
+                # Inline crossings BLOCK the dispatch (the pre-r15
+                # behavior the deadline exists to cut) — run them inside
+                # the same phase the armed gate accounts to, so a
+                # blocking stall and a bounded deadline wait decompose
+                # under ONE name and the bench can compare them on phase
+                # clocks instead of noisy whole-fleet walls.
+                with self.phases.phase("collective_gate"):
+                    for shard in range(n):
+                        self._gather_contribution(shard)
             return
         if not chaos.enabled() and not self._collective_pending:
             # On this harness the chaos hook is the only crossing body

@@ -128,16 +128,17 @@ EXTRACTORS = {
     },
     "ps_pull_push_latency": lambda d: {},  # indexed, not gated (shape varies)
     # graftreduce (r15): step time per sweep point (down), and the
-    # in-collective straggler degradation — subgroup-over-baseline excess
-    # (the skip-to-recover twin of r13's recovery_time, down).
+    # in-collective straggler degradation — the subgroup path's in-step
+    # wait on phase clocks (the skip-to-recover twin of r13's
+    # recovery_time, down).
     "collective_step_time_and_straggler_degradation": lambda d: {
         **{
             f"step_ms[dp{p.get('dp')}_{p.get('mode')}]": (p.get("step_ms"), LOWER)
             for p in d.get("sweep") or [] if isinstance(p, dict)
         },
-        "subgroup_degradation_ms": (
-            (d.get("chaos") or {}).get("degradation_ms", {})
-            .get("subgroup_over_baseline"), LOWER),
+        "subgroup_in_step_wait_ms": (
+            (d.get("chaos") or {}).get("in_step_wait_ms", {})
+            .get("subgroup"), LOWER),
     },
     "bench_all_configs": lambda d: {
         f"examples_per_sec_per_chip[{c.get('config')}]": (

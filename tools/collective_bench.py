@@ -357,12 +357,22 @@ def run_fleet(
         ) from result_box["error"]
     status = result_box["status"]
     done = int(status.get("done", 0))
+    # The in-step wait, on phase clocks: every gate crossing — a bounded
+    # deadline wait (gate armed) or a blocking inline stall (gate off) —
+    # lands in the worker's ``collective_gate`` phase, so this number is
+    # immune to the ±2-3 s process-spawn/scrape noise whole-fleet walls
+    # carry on this box.
+    gate_s = sum(
+        float(p.get("collective_gate", 0.0))
+        for p in (status.get("phase_times") or {}).values()
+    )
     out = {
         "label": label,
         "chaos": chaos,
         "collective_deadline_ms": deadline_ms,
         "stall_ms": stall_ms,
         "wall_s": round(wall, 2),
+        "gate_phase_s": round(gate_s, 3),
         "tasks_done": done,
         "tasks_expected": n_tasks,
         "abandoned": int(status.get("abandoned", 0)),
@@ -410,25 +420,37 @@ def run_chaos_family(args, tmp: str, log) -> dict:
         ),
     }
     base = fleets["baseline"]["wall_s"]
-    blocking_excess_ms = round(
-        (fleets["stall_blocking"]["wall_s"] - base) * 1e3, 1
-    )
-    subgroup_excess_ms = round(
-        (fleets["stall_subgroup"]["wall_s"] - base) * 1e3, 1
-    )
+    blocking_gate_ms = round(fleets["stall_blocking"]["gate_phase_s"] * 1e3, 1)
+    subgroup_gate_ms = round(fleets["stall_subgroup"]["gate_phase_s"] * 1e3, 1)
     skips = sum(fleets["stall_subgroup"]["collective_skips"].values())
     live = fleets["stall_subgroup"]["live_metrics"]
     return {
         "fleets": fleets,
         "stall_ms": args.stall_ms,
         "deadline_ms": args.deadline_ms,
-        # The three-way degradation story: blocking pays ~the stall,
-        # the subgroup path pays ~the deadline, and the r13
-        # evict-and-reform path paid 25.8 s.
-        "degradation_ms": {
-            "blocking_over_baseline": blocking_excess_ms,
-            "subgroup_over_baseline": subgroup_excess_ms,
+        # The three-way degradation story, on PHASE clocks (the
+        # noise-immune number — every gate crossing, blocking or
+        # deadline-bounded, is accounted under the worker's
+        # ``collective_gate`` phase): the blocking path pays ~the stall
+        # inside the step, the subgroup path pays ~the deadline, and the
+        # r13 evict-and-reform path paid 25.8 s.
+        "in_step_wait_ms": {
+            "blocking": blocking_gate_ms,
+            "subgroup": subgroup_gate_ms,
             "r13_sever_and_solo_drain": R13_SKIP_TO_TRAINED_MS,
+        },
+        # Whole-fleet wall excess over the fault-free baseline — stamped
+        # for context, NOT gated: a difference of ~15-20 s fleet walls
+        # on a 2-core box carries ±2-3 s process-spawn/scrape noise
+        # (the r12 wall-A/B stance; the phase numbers above are the
+        # comparison of record).
+        "wall_excess_ms_noisy": {
+            "blocking": round(
+                (fleets["stall_blocking"]["wall_s"] - base) * 1e3, 1
+            ),
+            "subgroup": round(
+                (fleets["stall_subgroup"]["wall_s"] - base) * 1e3, 1
+            ),
         },
         "subgroup_completed_with_skips": skips,
         "skip_observed_in_live_scrape": (
@@ -439,14 +461,18 @@ def run_chaos_family(args, tmp: str, log) -> dict:
                 f["zero_double_train"] for f in fleets.values()
             ),
             "subgroup_skipped": skips >= 1,
-            "subgroup_beats_blocking": subgroup_excess_ms < blocking_excess_ms,
-            # "Well under" = a 5x margin on the r13 evict-and-reform
-            # path.  The excess is a difference of ~15 s whole-fleet
-            # walls on a 2-core box whose process-spawn/scrape noise is
-            # ±2-3 s — a tighter bound would gate on weather, not on
-            # the subsystem (the r12 wall-A/B stance).
+            # The blocking fleet's in-step wait must show (most of) the
+            # stall — proof the fault actually wedged a dispatch.
+            "blocking_paid_the_stall": blocking_gate_ms >= args.stall_ms * 0.9,
+            "subgroup_beats_blocking": subgroup_gate_ms < blocking_gate_ms,
+            # Bounded by the deadline per gate pass (one pass per task,
+            # +1 for the warm-in crossing), not by the stall.
+            "subgroup_bounded_by_deadline": (
+                subgroup_gate_ms
+                <= args.deadline_ms * (args.tasks + 1)
+            ),
             "subgroup_well_under_r13": (
-                subgroup_excess_ms < R13_SKIP_TO_TRAINED_MS / 5
+                subgroup_gate_ms < R13_SKIP_TO_TRAINED_MS / 10
             ),
         },
     }
