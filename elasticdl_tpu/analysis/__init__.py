@@ -56,11 +56,27 @@ self-method and module-function call edges across the repo):
 - ``stale-waiver``          a waiver that suppresses no finding is itself
                             a finding (the inventory cannot rot)
 
+v5 adds thread-role inference (``analysis/thread_map.py``: Thread/Timer
+targets, executor ``submit``/``add_done_callback`` callables, gRPC
+handler tables, ``main``, and ``# thread-role:`` declarations, propagated
+over call edges plus a constructor-type layer) and on top of it:
+
+- ``shared-state``          a ``self.<attr>`` written on one thread role
+                            and touched on another must share a common
+                            lexically-held lock, or carry a checked
+                            escape hatch: ``# single-writer: <role>``
+                            (writes elsewhere are findings) or
+                            ``# gil-atomic`` (illegal on read-modify-
+                            write sites)
+
 The runtime twin of ``lock-order`` is ``common/locksan.py``: a debug lock
 wrapper that records actual acquisition orders under ``GRAFT_LOCKSAN=1``
 (on for tier-1 via tests/conftest.py) and raises on inversions or
 leaf-order violations — the static model and the runtime behavior gate
-each other.
+each other.  ``shared-state``'s runtime twin is ``common/racesan.py``
+(``GRAFT_RACESAN=1``, also tier-1-wide): opted-in classes record
+per-attribute (thread-role, held-locks) observations and raise on a
+cross-role unguarded write.
 
 Inline waivers: ``# graftlint: allow[<rule>] <reason>`` — the reason is
 mandatory; malformed waivers are themselves findings (``waiver-syntax``).
@@ -87,6 +103,7 @@ from elasticdl_tpu.analysis.import_hygiene import ImportHygienePass
 from elasticdl_tpu.analysis.lock_discipline import LockDisciplinePass
 from elasticdl_tpu.analysis.lock_order import LockOrderPass
 from elasticdl_tpu.analysis.rpc_discipline import RpcDisciplinePass
+from elasticdl_tpu.analysis.shared_state import SharedStatePass
 from elasticdl_tpu.analysis.thread_hygiene import ThreadHygienePass
 from elasticdl_tpu.analysis.trace_discipline import TraceDisciplinePass
 
@@ -104,6 +121,7 @@ def all_passes() -> list:
         ThreadHygienePass(),
         ImportHygienePass(),
         LockOrderPass(),
+        SharedStatePass(),
         TraceDisciplinePass(),
         ChaosDisciplinePass(),
         GaugeDisciplinePass(),

@@ -88,15 +88,30 @@ class LockAcquire:
 
 
 @dataclasses.dataclass
+class AttrAccess:
+    """One ``self.<attr>`` touch inside a class-scoped function, with the
+    locks lexically held at the site — the raw material of the v5
+    shared-state pass (analysis/shared_state.py)."""
+
+    attr: str
+    line: int
+    write: bool
+    rmw: bool  # read-modify-write in ONE site (augmented assignment)
+    held: Tuple[str, ...]
+
+
+@dataclasses.dataclass
 class FunctionInfo:
     qualname: str  # "module:Class.method" / "module:func" / anon scopes
     path: str
     line: int
     hot_path: bool
     resolvable: bool  # False for nested/anonymous scopes
+    cls_name: str = ""  # lexically enclosing class ("" for module funcs)
     calls: List[CallSite] = dataclasses.field(default_factory=list)
     blocking: List[BlockingCall] = dataclasses.field(default_factory=list)
     acquires: List[LockAcquire] = dataclasses.field(default_factory=list)
+    attr_accesses: List[AttrAccess] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -306,6 +321,7 @@ class CallGraph:
             line=node.lineno,
             hot_path=src.is_hot_path(node.lineno),
             resolvable=True,
+            cls_name=cls.name if cls is not None else "",
         )
         self.functions[qualname] = info
         self._walk(mod, src, cls, info, node.body, exempt=False, held=())
@@ -323,7 +339,7 @@ class CallGraph:
                 qualname=f"{info.qualname}.<{getattr(node, 'name', 'lambda')}"
                 f"@{node.lineno}>",
                 path=src.path, line=node.lineno, hot_path=False,
-                resolvable=False,
+                resolvable=False, cls_name=info.cls_name,
             )
             self.functions[anon.qualname] = anon
             body = node.body if isinstance(node.body, list) else [node.body]
@@ -356,6 +372,58 @@ class CallGraph:
                 # taken while recovering still nests for real).
                 self._walk(mod, src, cls, info, h.body, True, held)
             return
+        if isinstance(node, ast.AugAssign):
+            # ``self.x += 1`` is a read AND a write at one site — the
+            # check-and-set shape the shared-state pass must see as a
+            # read-modify-write (never legal under '# gil-atomic').
+            # ``self.d[k] += 1`` mutates the SHARED CONTAINER through the
+            # attribute: same read-modify-write judgement on the attr.
+            attr = _self_attr(node.target)
+            if attr is None and isinstance(node.target, ast.Subscript):
+                attr = _self_attr(node.target.value)
+                if attr is not None and cls is not None:
+                    info.attr_accesses.append(AttrAccess(
+                        attr=attr, line=node.lineno, write=True, rmw=True,
+                        held=held,
+                    ))
+                    self._visit(
+                        mod, src, cls, info, node.target.slice, exempt, held
+                    )
+                    self._visit(mod, src, cls, info, node.value, exempt, held)
+                    return
+                self._visit(mod, src, cls, info, node.target, exempt, held)
+            elif attr is not None and cls is not None:
+                info.attr_accesses.append(AttrAccess(
+                    attr=attr, line=node.lineno, write=True, rmw=True,
+                    held=held,
+                ))
+            self._visit(mod, src, cls, info, node.value, exempt, held)
+            return
+        if isinstance(node, ast.Subscript) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            # ``self.d[k] = v`` / ``del self.d[k]`` mutate the shared
+            # container: a WRITE of the attribute (single-op, not rmw —
+            # dict/list item set is one GIL-atomic op).  The generic
+            # recursion below still records the receiver's Load, which is
+            # harmless (same line, same held set).
+            attr = _self_attr(node.value)
+            if attr is not None and cls is not None:
+                info.attr_accesses.append(AttrAccess(
+                    attr=attr, line=node.lineno, write=True, rmw=False,
+                    held=held,
+                ))
+        if isinstance(node, ast.Attribute):
+            # Innermost ``self.<attr>`` only: for ``self.a.b`` the chain
+            # recurses down to the ``self.a`` load (the shared slot) —
+            # ``b`` lives on another object.
+            attr = _self_attr(node)
+            if attr is not None and cls is not None:
+                info.attr_accesses.append(AttrAccess(
+                    attr=attr, line=node.lineno,
+                    write=isinstance(node.ctx, (ast.Store, ast.Del)),
+                    rmw=False, held=held,
+                ))
         if isinstance(node, ast.Call):
             reason = blocking_reason(node)
             if reason is not None:
@@ -447,6 +515,42 @@ class CallGraph:
         # ``import a.b`` bound ``a``: the chain ``a.b.f`` walks a.b.
         cand2 = f"{head}.{rest}" if rest else head
         return cand2 if cand2 in self._modules else None
+
+    # -- class resolution (the thread-map's constructor-type layer) --
+
+    def resolve_class(self, mod: str, func: ast.expr) -> Optional[str]:
+        """``ClassName(...)``'s class as ``"module:Class"`` when it is a
+        repo class visible from ``mod`` (local, ``from m import Class``,
+        or ``m.Class``); None otherwise."""
+        if isinstance(func, ast.Name):
+            name = func.id
+            if name in self._mod_classes.get(mod, {}):
+                return f"{mod}:{name}"
+            tgt = self._from_imports.get(mod, {}).get(name)
+            if tgt is not None:
+                base, leaf = tgt
+                if leaf in self._mod_classes.get(base, {}):
+                    return f"{base}:{leaf}"
+            return None
+        if isinstance(func, ast.Attribute):
+            chain = _chain(func)
+            if not chain or "." not in chain:
+                return None
+            prefix, leaf = chain.rsplit(".", 1)
+            target_mod = self._resolve_module(mod, prefix)
+            if target_mod is not None and leaf in self._mod_classes.get(
+                target_mod, {}
+            ):
+                return f"{target_mod}:{leaf}"
+        return None
+
+    def class_method(self, cls_q: str, meth: str) -> Optional[str]:
+        """``("module:Class", "meth")`` -> the method's qualname when the
+        class declares it."""
+        mod, _, cls = cls_q.partition(":")
+        if meth in self._mod_classes.get(mod, {}).get(cls, ()):
+            return f"{mod}:{cls}.{meth}"
+        return None
 
     # -- derived: transitive blocking --
 

@@ -47,6 +47,11 @@ KNOWN_RULES = {
     # v2 interprocedural passes (analysis/callgraph.py layer).
     "blocking-propagation",
     "lock-order",
+    # v5: thread-role inference (analysis/thread_map.py) + cross-role
+    # unguarded shared state (analysis/shared_state.py); also covers the
+    # '# thread-role:' / '# single-writer:' / '# gil-atomic' annotation
+    # grammar, which the pass validates itself.
+    "shared-state",
     # A waiver that suppresses no finding is itself a finding: the waiver
     # inventory must not rot as code moves (see run_passes).
     "stale-waiver",

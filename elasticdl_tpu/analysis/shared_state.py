@@ -1,0 +1,262 @@
+"""shared-state: unannotated state crossing thread roles must be locked.
+
+The v5 race detector.  lock-discipline only judges attributes someone
+already annotated ``# guarded-by:``; every check-and-set race the review
+rounds hand-found since r6 (``_max_steps_hit``, ``_known_workers``, the
+lazy IngestPool creation) lived in UNannotated state shared between the
+task loop and a gRPC pool / watcher / timer thread.  This pass closes
+that hole on top of the thread map (analysis/thread_map.py):
+
+For every ``self.<attr>`` of a class, collect each access site with its
+thread roles (from the map) and the locks lexically held there (the
+lock-order held-lock context, plus the ``# guarded-by: <lock>`` def-line
+convention for called-with-lock-held helpers).  An attribute is a
+finding when
+
+- it is WRITTEN outside ``__init__`` on some role, and
+- its access sites span >= 2 distinct roles, and
+- the sites share NO common held lock.
+
+Accesses in ``__init__`` are exempt (construction happens-before the
+spawn that publishes ``self``), as are sites in functions whose role the
+map cannot infer (unknown context must not manufacture findings).
+
+Escape hatches — each itself checked — on the declaring assignment line:
+
+- ``# guarded-by: <lock>``      lock-discipline owns it (out of scope
+                                here);
+- ``# single-writer: <role>``   only ``<role>`` may write (any write
+                                site on another role is a finding; reads
+                                ride the GIL's torn-free loads).  The
+                                role must exist in the thread map;
+- ``# gil-atomic``              single-op loads/plain stores only: an
+                                augmented assignment (read-modify-write
+                                at one site) under this annotation is a
+                                finding;
+- ``# graftlint: allow[shared-state] <reason>`` — the reasoned waiver.
+
+Blind spots, by design (the runtime twin ``common/racesan.py`` covers
+the dynamic side): instance confinement (a per-thread instance of a
+shared class still looks cross-role), same-role concurrency (two
+threads of one role), state shared through containers/globals rather
+than ``self``, and roles the map cannot reach (see the thread-map blind
+spots).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from elasticdl_tpu.analysis.callgraph import shared_graph
+from elasticdl_tpu.analysis.core import Finding, LintPass, SourceFile
+from elasticdl_tpu.analysis.import_hygiene import _module_name
+from elasticdl_tpu.analysis.thread_map import MAIN_ROLE, shared_thread_map
+
+_SINGLE_WRITER = re.compile(
+    r"#\s*single-writer\s*:\s*(?P<role>[^#]*)"
+)
+_GIL_ATOMIC = re.compile(r"#\s*gil-atomic\b")
+
+
+class _Site:
+    __slots__ = ("path", "line", "write", "rmw", "held", "roles", "func")
+
+    def __init__(self, path, line, write, rmw, held, roles, func):
+        self.path = path
+        self.line = line
+        self.write = write
+        self.rmw = rmw
+        self.held = held  # frozenset of lock tokens
+        self.roles = roles  # frozenset of role names
+        self.func = func  # short function name for the witness text
+
+    def witness(self) -> str:
+        kind = "rmw" if self.rmw else ("write" if self.write else "read")
+        roles = ",".join(sorted(self.roles)) or "?"
+        return f"{kind}@{self.path}:{self.line} [{roles}] in {self.func}"
+
+
+class SharedStatePass(LintPass):
+    name = "shared-state"
+    description = (
+        "a self.<attr> written on one thread role and touched on another "
+        "must share a lock, or carry '# single-writer: <role>' / "
+        "'# gil-atomic' / '# guarded-by: <lock>' on its declaring line"
+    )
+
+    def run_project(self, files: Sequence[SourceFile]) -> Iterable[Finding]:
+        graph = shared_graph(files)
+        tmap = shared_thread_map(files)
+        findings: List[Finding] = list(tmap.errors)
+        known_roles = tmap.known_roles() | {MAIN_ROLE}
+
+        guarded, annos = self._scan_annotations(files, findings, known_roles)
+
+        # Group access sites by (module:Class, attr).
+        sites: Dict[Tuple[str, str], List[_Site]] = {}
+        for q, fn in graph.functions.items():
+            if not fn.cls_name or not fn.attr_accesses:
+                continue
+            mod = q.split(":", 1)[0]
+            cls_key = f"{mod}:{fn.cls_name}"
+            method = q.split(":", 1)[1]
+            if method == f"{fn.cls_name}.__init__":
+                continue  # construction happens-before publication
+            src = graph.sources.get(fn.path)
+            extra_held = ()
+            if src is not None:
+                lock = src.guarded_by(fn.line)
+                if lock is not None:
+                    extra_held = (f"{cls_key}.{lock}",)
+            roles = tmap.roles_of(q)
+            func_short = method
+            for acc in fn.attr_accesses:
+                sites.setdefault((cls_key, acc.attr), []).append(_Site(
+                    fn.path, acc.line, acc.write, acc.rmw,
+                    frozenset(acc.held) | frozenset(extra_held),
+                    roles, func_short,
+                ))
+
+        for (cls_key, attr), accs in sorted(sites.items()):
+            if f"{cls_key}.{attr}" in graph.locks:
+                continue  # the lock itself, not data
+            if attr in guarded.get(cls_key, ()):
+                continue  # lock-discipline owns it
+            anno = annos.get((cls_key, attr))
+            if anno is not None and anno[0] == "gil-atomic":
+                for s in accs:
+                    if s.rmw:
+                        findings.append(Finding(
+                            self.name, s.path, s.line,
+                            f"self.{attr} is declared '# gil-atomic' but "
+                            "this site is a read-modify-write (augmented "
+                            "assignment) — gil-atomic is only legal on "
+                            "single-op load/store sites; lock it or drop "
+                            "the annotation",
+                        ))
+                continue
+            if anno is not None and anno[0] == "single-writer":
+                writer = anno[1]
+                for s in accs:
+                    if s.write and s.roles and not (s.roles <= {writer}):
+                        findings.append(Finding(
+                            self.name, s.path, s.line,
+                            f"self.{attr} is declared '# single-writer: "
+                            f"{writer}' but written on role(s) "
+                            f"{','.join(sorted(s.roles))} at this site — "
+                            "route the write through the declared writer "
+                            "role or lock the attribute",
+                        ))
+                continue
+            findings.extend(self._cross_role(cls_key, attr, accs))
+        return findings
+
+    # -- annotations --
+
+    def _scan_annotations(
+        self, files: Sequence[SourceFile], findings: List[Finding],
+        known_roles,
+    ):
+        """Per class: the '# guarded-by' attr set (lock-discipline's
+        contract) and the v5 single-writer/gil-atomic declarations."""
+        guarded: Dict[str, set] = {}
+        annos: Dict[Tuple[str, str], Tuple[str, str]] = {}
+        for src in files:
+            mod = _module_name(src.path) or src.path
+            for node in src.tree.body:
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                cls_key = f"{mod}:{node.name}"
+                for sub in ast.walk(node):
+                    if not isinstance(
+                        sub, (ast.Assign, ast.AnnAssign, ast.AugAssign)
+                    ):
+                        continue
+                    targets = (
+                        sub.targets if isinstance(sub, ast.Assign)
+                        else [sub.target]
+                    )
+                    attrs = [
+                        t.attr for t in targets
+                        if isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ]
+                    if not attrs:
+                        continue
+                    if src.guarded_by(sub.lineno) is not None:
+                        guarded.setdefault(cls_key, set()).update(attrs)
+                    comment = src.comments.get(sub.lineno, "")
+                    m = _SINGLE_WRITER.search(comment)
+                    if m is not None:
+                        # First token only: trailing prose is rationale.
+                        tokens = m.group("role").split()
+                        role = tokens[0] if tokens else ""
+                        if role not in known_roles:
+                            findings.append(Finding(
+                                self.name, src.path, sub.lineno,
+                                f"single-writer names unknown role {role!r}"
+                                " — the role must be one the thread map "
+                                "infers (see tools/graftlint.py "
+                                "--threadmap)",
+                            ))
+                        else:
+                            for attr in attrs:
+                                annos.setdefault(
+                                    (cls_key, attr), ("single-writer", role)
+                                )
+                    elif _GIL_ATOMIC.search(comment):
+                        for attr in attrs:
+                            annos.setdefault(
+                                (cls_key, attr), ("gil-atomic", "")
+                            )
+        return guarded, annos
+
+    # -- the core judgement --
+
+    @staticmethod
+    def _pair_conflicts(w: _Site, s: _Site) -> bool:
+        """A write site and another site can race iff they may run on
+        DIFFERENT roles concurrently and share no held lock.  Judged
+        pairwise — a global all-site lock intersection would flag a
+        writer role's own unlocked read of its attribute, which cannot
+        race the writes it is sequenced with."""
+        if not w.held.isdisjoint(s.held):
+            return False
+        # Two distinct roles exist across the pair iff the union spans
+        # >= 2 (this also covers w IS s: one multi-role site races
+        # itself); a single shared role means the sites are sequenced on
+        # one domain and cannot race.
+        return len(w.roles | s.roles) >= 2
+
+    def _cross_role(
+        self, cls_key: str, attr: str, accs: List[_Site]
+    ) -> List[Finding]:
+        judged = [s for s in accs if s.roles]
+        writes = [s for s in judged if s.write]
+        if not writes:
+            return []
+        if len(frozenset().union(*(s.roles for s in judged))) < 2:
+            return []
+        # One finding per attribute, anchored at the first conflicting
+        # write site so a single reasoned waiver (or fix) covers it.
+        for w in sorted(writes, key=lambda s: (s.path, s.line)):
+            other = next(
+                (s for s in judged if self._pair_conflicts(w, s)), None
+            )
+            if other is None:
+                continue
+            pair_roles = sorted(w.roles | other.roles)
+            cls_short = cls_key.split(":", 1)[1]
+            return [Finding(
+                self.name, w.path, w.line,
+                f"{cls_short}.{attr} is shared across thread roles "
+                f"({', '.join(pair_roles)}) with no common lock: "
+                f"{w.witness()} vs {other.witness()} — guard both sites "
+                "with one lock, or declare '# single-writer: <role>' / "
+                "'# gil-atomic' on the declaring line, or waive with a "
+                "reason",
+            )]
+        return []

@@ -12,6 +12,17 @@ constructor must either
   ``threads = [...]; for t in threads: t.start(); ... t.join()`` pattern,
   or a ``<t>.daemon = True`` assignment in that scope.
 
+``threading.Timer(...)`` (v5) is a Thread subclass whose constructor
+takes NO ``daemon=`` kwarg, so its proof set is the scope-local
+``<t>.daemon = True`` assignment, a ``.join(...)``, or a ``.cancel()``
+(a cancelled timer cannot outlive the scope's intent).
+
+``ThreadPoolExecutor(...)`` (v5) owns non-daemon worker threads; a bare
+anonymous pool leaks them.  A constructor is accounted when it is
+assigned to a ``self.<attr>`` (the owner manages shutdown), passed
+directly as an argument to another call (``grpc.server(...)`` owns it),
+used as a context manager, or its scope calls ``.shutdown(...)``.
+
 The join-proof is scope-local and name-blind (it accepts any ``x.join()``
 in the scope that is not a string/``os.path`` join): a cross-function
 hand-off (constructed here, joined elsewhere) is expressed with a waiver
@@ -26,11 +37,24 @@ from typing import Iterable, List
 from elasticdl_tpu.analysis.core import Finding, LintPass, SourceFile, attr_chain
 
 
-def _is_thread_ctor(node: ast.Call) -> bool:
+def _ctor_kind(node: ast.Call) -> str:
+    """'thread' | 'timer' | 'pool' | '' for a constructor call."""
     chain = attr_chain(node.func)
-    return chain == "threading.Thread" or (
-        isinstance(node.func, ast.Name) and node.func.id == "Thread"
+    tail = chain.split(".")[-1] if chain else (
+        node.func.id if isinstance(node.func, ast.Name) else ""
     )
+    head_ok = chain in ("", tail) or chain.startswith(
+        ("threading.", "futures.", "concurrent.futures.")
+    )
+    if not head_ok:
+        return ""
+    if tail == "Thread":
+        return "thread"
+    if tail == "Timer":
+        return "timer"
+    if tail == "ThreadPoolExecutor":
+        return "pool"
+    return ""
 
 
 def _has_daemon_true(node: ast.Call) -> bool:
@@ -40,10 +64,12 @@ def _has_daemon_true(node: ast.Call) -> bool:
     return False
 
 
-def _scope_has_join_or_daemon_set(scope: ast.AST) -> bool:
+def _scope_has(scope: ast.AST, attrs: tuple, daemon_set: bool) -> bool:
+    """A ``.{attr}(...)`` call (excluding string/os.path joins), or — when
+    ``daemon_set`` — a ``<t>.daemon = True`` assignment, in ``scope``."""
     for node in ast.walk(scope):
         if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
-            if node.func.attr == "join":
+            if node.func.attr in attrs:
                 recv = node.func.value
                 # Exclude the two common non-thread joins: "sep".join(...)
                 # and os.path.join(...).
@@ -52,7 +78,7 @@ def _scope_has_join_or_daemon_set(scope: ast.AST) -> bool:
                 if attr_chain(recv).endswith("path"):
                     continue
                 return True
-        if isinstance(node, ast.Assign):
+        if daemon_set and isinstance(node, ast.Assign):
             for t in node.targets:
                 if isinstance(t, ast.Attribute) and t.attr == "daemon":
                     return True
@@ -62,8 +88,8 @@ def _scope_has_join_or_daemon_set(scope: ast.AST) -> bool:
 class ThreadHygienePass(LintPass):
     name = "thread-hygiene"
     description = (
-        "threading.Thread must be daemonized at construction or joined in "
-        "the same scope"
+        "threading.Thread/Timer must be daemonized, joined (or cancelled) "
+        "in scope; a ThreadPoolExecutor must be owned or shut down"
     )
 
     def run(self, src: SourceFile) -> Iterable[Finding]:
@@ -72,10 +98,11 @@ class ThreadHygienePass(LintPass):
         return findings
 
     def _check_scope(self, src, scope, findings) -> None:
-        # Per lexical scope: collect this scope's Thread ctors (not those
-        # of nested functions), then recurse into nested functions.
+        # Per lexical scope: collect this scope's ctors (not those of
+        # nested functions), then recurse into nested functions.
         nested = []
-        ctors: List[ast.Call] = []
+        ctors: List[tuple] = []  # (kind, node)
+        owned_pools: set = set()  # pool ctor nodes accounted structurally
         stack = list(
             scope.body if isinstance(scope.body, list) else [scope.body]
         )
@@ -84,17 +111,68 @@ class ThreadHygienePass(LintPass):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 nested.append(node)
                 continue
-            if isinstance(node, ast.Call) and _is_thread_ctor(node):
-                ctors.append(node)
+            if isinstance(node, ast.Call):
+                kind = _ctor_kind(node)
+                if kind:
+                    ctors.append((kind, node))
+                # A pool handed DIRECTLY to another call is owned by the
+                # receiver (grpc.server(ThreadPoolExecutor(...))).
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    if isinstance(arg, ast.Call) and _ctor_kind(arg) == "pool":
+                        owned_pools.add(id(arg))
+            if isinstance(node, ast.Assign):
+                # Assigned to self.<attr> (anywhere in the value subtree —
+                # conditional construction like ``X() if par else None``
+                # included): the owner manages shutdown.
+                if any(
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                    for t in node.targets
+                ):
+                    for sub in ast.walk(node.value):
+                        if isinstance(sub, ast.Call) and _ctor_kind(sub) == "pool":
+                            owned_pools.add(id(sub))
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    ctx = item.context_expr
+                    if isinstance(ctx, ast.Call) and _ctor_kind(ctx) == "pool":
+                        owned_pools.add(id(ctx))
             stack.extend(ast.iter_child_nodes(node))
-        bad = [c for c in ctors if not _has_daemon_true(c)]
-        if bad and not _scope_has_join_or_daemon_set(scope):
-            for c in bad:
+
+        threads = [
+            c for k, c in ctors if k == "thread" and not _has_daemon_true(c)
+        ]
+        if threads and not _scope_has(scope, ("join",), daemon_set=True):
+            for c in threads:
                 findings.append(Finding(
                     self.name, src.path, c.lineno,
                     "thread is neither daemonized (daemon=True) nor joined "
                     "in this scope — a leaked non-daemon thread blocks "
                     "process exit",
+                ))
+        timers = [c for k, c in ctors if k == "timer"]
+        if timers and not _scope_has(
+            scope, ("join", "cancel"), daemon_set=True
+        ):
+            for c in timers:
+                findings.append(Finding(
+                    self.name, src.path, c.lineno,
+                    "Timer is neither daemonized (<t>.daemon = True — the "
+                    "ctor takes no daemon kwarg), joined, nor cancelled in "
+                    "this scope — a pending non-daemon timer blocks "
+                    "process exit",
+                ))
+        pools = [
+            c for k, c in ctors if k == "pool" and id(c) not in owned_pools
+        ]
+        if pools and not _scope_has(scope, ("shutdown",), daemon_set=False):
+            for c in pools:
+                findings.append(Finding(
+                    self.name, src.path, c.lineno,
+                    "executor is neither owned (self.<attr> assignment, "
+                    "passed to an owning call, with-block) nor shut down "
+                    "in this scope — its non-daemon workers leak",
                 ))
         for fn in nested:
             self._check_scope(src, fn, findings)

@@ -141,6 +141,16 @@ class Counter(_Metric):
         with self._lock:
             self._v += v
 
+    def set_total(self, v: float) -> None:
+        """Scrape-side mirror of an EXTERNAL monotonic aggregate (the
+        locksan acquire counts): a collector overwrites the cumulative
+        total it reads elsewhere.  Hot-path update sites keep using
+        ``inc`` — mixing the two on one series would lose counts."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._v = float(v)
+
     def value(self) -> float:
         with self._lock:
             return self._v
@@ -211,6 +221,25 @@ class Histogram(_Metric):
                 "sum": self._sum,
                 "count": self._count,
             }
+
+    def load_snapshot(self, snap: dict) -> None:
+        """Scrape-side mirror of an EXTERNAL histogram aggregate (the
+        locksan wait-time buckets): a collector overwrites this series
+        with the cumulative state it reads elsewhere.  The edge grid must
+        match bucket-for-bucket — a silent re-bucketing would render a
+        histogram whose counts mean nothing."""
+        if not self.enabled:
+            return
+        edges = tuple(float(e) for e in snap.get("edges") or ())
+        counts = list(snap.get("counts") or ())
+        if edges != self.edges or len(counts) != len(self.edges) + 1:
+            raise ValueError(
+                "load_snapshot edge grid does not match this histogram's"
+            )
+        with self._lock:
+            self._counts = counts
+            self._sum = float(snap.get("sum", 0.0))
+            self._count = int(snap.get("count", 0))
 
     def quantile(self, q: float) -> Optional[float]:
         """Approximate quantile by linear interpolation inside the owning
@@ -473,6 +502,38 @@ _DEFAULT = Registry()
 
 def default() -> Registry:
     return _DEFAULT
+
+
+# -- locksan contention bridge (r16) ---------------------------------------
+
+
+def install_lock_collector(registry: Registry) -> Callable[[], None]:
+    """Expose locksan's per-lock-name contention aggregates as
+    ``edl_lock_acquire_total`` / ``edl_lock_wait_ms{lock=...}`` on
+    ``registry`` — a scrape-side collector (the pull model: lock waits
+    are cheap to READ in aggregate but must cost the acquire path
+    nothing when nobody scrapes).  Recording in locksan starts at
+    install time; with the sanitizer off (``GRAFT_LOCKSAN`` unset) locks
+    are plain and the families simply stay empty.  Returns the collector
+    (for ``remove_collector`` in tests)."""
+    locksan.enable_contention_stats(DEFAULT_BUCKET_EDGES_MS)
+
+    def _collect() -> None:
+        for name, rec in locksan.contention_snapshot().items():
+            labels = {"lock": name}
+            registry.counter(
+                "edl_lock_acquire_total",
+                "sanitized-lock acquisitions by lock name",
+                labels=labels,
+            ).set_total(rec["acquires"])
+            registry.histogram(
+                "edl_lock_wait_ms",
+                "wall waited inside sanitized-lock acquire, by lock name",
+                labels=labels,
+            ).load_snapshot(rec["wait_ms"])
+
+    registry.add_collector(_collect)
+    return _collect
 
 
 # -- fleet-view helpers (jax-free; the master's aggregation math) ----------

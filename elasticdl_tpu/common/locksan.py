@@ -36,14 +36,16 @@ Pure stdlib: imported by master-process modules, which must stay jax-free
 
 from __future__ import annotations
 
+import bisect
 import os
 import threading
+import time
 import traceback
 from typing import Dict, Iterable, List, Optional, Tuple
 
 __all__ = [
     "LockOrderViolation", "enabled", "lock", "rlock", "observed_edges",
-    "reset",
+    "reset", "held_names", "enable_contention_stats", "contention_snapshot",
 ]
 
 
@@ -81,10 +83,12 @@ def _site() -> str:
 
 
 def reset() -> None:
-    """Forget observed edges (test isolation; the per-thread held stacks
-    empty themselves when locks release)."""
+    """Forget observed edges and contention aggregates (test isolation;
+    the per-thread held stacks empty themselves when locks release)."""
     with _edges_lock:
         _edges.clear()
+    with _stats_lock:
+        _stats.clear()
 
 
 def observed_edges() -> Dict[Tuple[str, str], str]:
@@ -92,6 +96,71 @@ def observed_edges() -> Dict[Tuple[str, str], str]:
     witness site (debugging / tests)."""
     with _edges_lock:
         return dict(_edges)
+
+
+def held_names() -> Tuple[str, ...]:
+    """Names of the sanitized locks the CURRENT thread holds — the lock
+    context common/racesan.py records per shared-state observation."""
+    return tuple(h.name for h in _held())
+
+
+# -- contention stats (r16): per-lock-name acquire count + wait histogram.
+#
+# Recording is OFF until a scrape-side consumer installs it
+# (gauge.install_lock_collector); un-installed, each acquire pays one
+# module-global check.  Aggregates are raw (count/sum/bucket counts on a
+# caller-supplied edge grid) because this module must stay import-light:
+# common/gauge.py imports locksan, so the bridge lives THERE and mirrors
+# these aggregates into edl_lock_acquire_total / edl_lock_wait_ms at
+# scrape time.
+
+_stats_lock = threading.Lock()
+_stats_enabled = False
+_stats_edges: Tuple[float, ...] = ()
+#: name -> [acquire_count, wait_sum_ms, per-bucket counts (len(edges)+1)]
+_stats: Dict[str, list] = {}
+
+
+def enable_contention_stats(edges_ms: Iterable[float]) -> None:
+    """Start aggregating per-lock-name wait times on ``edges_ms`` (the
+    shared gauge grid).  Idempotent; existing aggregates are kept when
+    the grid is unchanged, reset when it differs."""
+    global _stats_enabled, _stats_edges
+    edges = tuple(float(e) for e in edges_ms)
+    with _stats_lock:
+        if edges != _stats_edges:
+            _stats.clear()
+            _stats_edges = edges
+        _stats_enabled = True
+
+
+def contention_snapshot() -> Dict[str, dict]:
+    """Per-lock-name ``{"acquires", "wait_ms": {edges, counts, sum,
+    count}}`` — the collector's input; empty until stats are enabled and
+    a sanitized lock has been acquired."""
+    with _stats_lock:
+        edges = list(_stats_edges)
+        return {
+            name: {
+                "acquires": rec[0],
+                "wait_ms": {
+                    "edges": edges, "counts": list(rec[2]),
+                    "sum": rec[1], "count": rec[0],
+                },
+            }
+            for name, rec in sorted(_stats.items())
+        }
+
+
+def _record_wait(name: str, wait_ms: float) -> None:
+    idx = bisect.bisect_left(_stats_edges, wait_ms)
+    with _stats_lock:
+        rec = _stats.get(name)
+        if rec is None:
+            rec = _stats[name] = [0, 0.0, [0] * (len(_stats_edges) + 1)]
+        rec[0] += 1
+        rec[1] += wait_ms
+        rec[2][min(idx, len(rec[2]) - 1)] += 1
 
 
 class _SanLock:
@@ -170,7 +239,13 @@ class _SanLock:
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         self._check_order()
-        got = self._lock.acquire(blocking, timeout)
+        if not _stats_enabled:
+            got = self._lock.acquire(blocking, timeout)
+        else:
+            t0 = time.monotonic()
+            got = self._lock.acquire(blocking, timeout)
+            if got:
+                _record_wait(self.name, (time.monotonic() - t0) * 1000.0)
         if got:
             _held().append(self)
         return got

@@ -157,16 +157,30 @@ def maybe_start(
     port: int,
     render_fn: Callable[[], str],
     health_fn: Optional[Callable[[], Dict]] = None,
+    registry=None,
 ) -> Optional[MetricsHTTPServer]:
     """The one wiring idiom every main shares: ``port < 0`` = disabled
     (None), else bind-and-start (0 = ephemeral).  A bind failure logs and
-    returns None — observability must never take the job down."""
+    returns None — observability must never take the job down.
+
+    ``registry`` (a ``gauge.Registry``, usually the one behind
+    ``render_fn``) additionally installs the locksan contention
+    collector (r16): lock acquire counts + wait-time histograms join the
+    endpoint as ``edl_lock_acquire_total`` / ``edl_lock_wait_ms`` —
+    only once an endpoint exists does anyone pay for recording them."""
     if port < 0:
         return None
     try:
-        return MetricsHTTPServer(
+        server = MetricsHTTPServer(
             render_fn, health_fn=health_fn, port=port
         ).start()
+        if registry is not None:
+            # AFTER the successful bind: a failed endpoint must not leave
+            # contention recording permanently on with nobody scraping.
+            from elasticdl_tpu.common import gauge
+
+            gauge.install_lock_collector(registry)
+        return server
     except OSError:
         logger.exception(
             "metrics endpoint failed to bind port %d; continuing without",

@@ -338,6 +338,7 @@ class JsonRpcClient:
         if self._schemas is not None:
             validate_message(method, request, self._schemas)
         if method not in self._stubs:
+            # graftlint: allow[shared-state] idempotent per-method stub memo: racing creators (loop + beat threads) build equivalent stubs and the dict item set is atomic
             self._stubs[method] = self._channel.unary_unary(
                 f"/{self._service}/{method}",
                 request_serializer=_serialize,

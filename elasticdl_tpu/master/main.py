@@ -245,6 +245,7 @@ class Master:
             config.gauge_port,
             self.servicer.fleet.render,
             health_fn=self.servicer.fleet.health,
+            registry=self.servicer.fleet.registry,
         )
 
     def _collect_pod_gauges(self) -> None:

@@ -37,7 +37,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
-from elasticdl_tpu.common import locksan, trace
+from elasticdl_tpu.common import locksan, racesan, trace
 from elasticdl_tpu.common.config import JobConfig
 from elasticdl_tpu.common.log_utils import get_logger
 
@@ -678,6 +678,11 @@ def run_watch_loop(stream_factory, emit, stop, backoff_s: float = 1.0) -> None:
             stop.wait(backoff_s)
 
 
+# racesan (r16): fleet state lives under _lock; _listeners is
+# append-at-wiring (master main, before scale()) and iterated on
+# watcher threads — single-op atomic by declaration, like the
+# rendezvous listener list.
+@racesan.instrument(atomic=("_listeners",))
 class PodManager:
     """Slot-based worker fleet: start, watch, relaunch, scale.
 

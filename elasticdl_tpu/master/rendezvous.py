@@ -17,9 +17,13 @@ from __future__ import annotations
 import time
 from typing import Callable, Dict, List, Optional
 
-from elasticdl_tpu.common import locksan, trace
+from elasticdl_tpu.common import locksan, racesan, trace
 
 
+# racesan (r16): every mutable field lives under _lock; _listeners is
+# append-at-wiring (before events flow) and list iteration/append are
+# single-op atomic, so it is declared atomic rather than locked.
+@racesan.instrument(atomic=("_listeners",))
 class RendezvousServer:
     def __init__(
         self,

@@ -290,8 +290,10 @@ class Trainer:
         # ZeRO-style optimizer-state shard plan (opt_shard_plan) — set by
         # shard_state once the mode resolves against this mesh; None =
         # replicated layout.
-        self._opt_plan = None
-        self._snapshot_fn = None
+        # Rebuilt only on the task loop (set_mesh / shard_state); the
+        # preemption thread's snapshot_state reads the current refs.
+        self._opt_plan = None  # single-writer: main
+        self._snapshot_fn = None  # single-writer: main
         # Per-batch-structure step caches (see _structured); _train_step
         # keeps pointing at the most recently used build (profiling tools).
         self._train_steps: Dict = {}
@@ -305,7 +307,9 @@ class Trainer:
         # gRPC PS service tier when the job runs PS pods (config.ps_addresses
         # — ps/service.py).  The trainer pulls/injects per step and pushes
         # the sparse cotangents back (models/spec.HostTableIO).
-        self._host_stores: Dict[str, Any] = {}
+        # Replaced wholesale on restore (task loop only); background
+        # checkpoint threads read the dict reference atomically.
+        self._host_stores: Dict[str, Any] = {}  # single-writer: main
         self._remote_ps = False
         if spec.host_io:
             if spec.batch_shard_dim != 0:
@@ -783,6 +787,7 @@ class Trainer:
                     opt_state=self._opt_map(canon, s.opt_state, plan)
                 )
 
+            # graftlint: allow[shared-state] idempotent jit memo: a racing rebuild costs one duplicate compile of the same function, and either reference is valid
             self._snapshot_fn = jax.jit(snap)
         return self._snapshot_fn(state)
 

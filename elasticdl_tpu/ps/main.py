@@ -95,6 +95,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             "shard": slot,
             "num_shards": num_shards,
         },
+        registry=server.gauges,
     )
 
     stop = threading.Event()

@@ -22,13 +22,17 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, Dict, Optional
 
-from elasticdl_tpu.common import trace
+from elasticdl_tpu.common import racesan, trace
 from elasticdl_tpu.common.checkpoint import read_manifest
 from elasticdl_tpu.common.log_utils import get_logger
 
 logger = get_logger("serving.ckpt_watcher")
 
 
+# racesan (r16): _applied is single-writer (the watcher thread); the
+# main/server-side applied_step() read rides a GIL-atomic int load, so
+# the attribute is declared atomic rather than locked.
+@racesan.instrument(atomic=("_applied",))
 class CheckpointWatcher:
     """Manifest poller: ``on_new_step(step, manifest)`` per published change.
 
@@ -50,7 +54,11 @@ class CheckpointWatcher:
         self._on_new_step = on_new_step
         # initial_step: the step the server already loaded at startup, so
         # the first poll does not redundantly re-apply it.
-        self._applied: Optional[int] = initial_step  # watcher/poke threads only
+        # poke() is "also the deterministic test/bench hook" — callable
+        # from any thread — so the consistency story is single-op
+        # atomicity (matching the runtime opt-in's atomic=("_applied",)),
+        # not a single writer role.
+        self._applied: Optional[int] = initial_step  # gil-atomic
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, name=f"edl-ckpt-watch:{name}", daemon=True

@@ -473,6 +473,7 @@ class ServingServer:
             self._gauge_port,
             self.gauges.render_prometheus,
             health_fn=lambda: {"role": "serving", "model": self.spec.name},
+            registry=self.gauges,
         )
         logger.info(
             "serving %s on port %d (max_batch %d, deadline %.1fms)",

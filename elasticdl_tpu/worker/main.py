@@ -380,6 +380,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             "worker_id": worker_id,
             "membership_version": worker._membership_version,
         },
+        registry=worker.gauges,
     )
     try:
         result = worker.run(membership=membership)

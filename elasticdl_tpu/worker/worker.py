@@ -163,16 +163,22 @@ class Worker:
             self._dpw = len(jax.local_devices())
         self._poll = poll_interval_s
 
-        self.trainer: Optional[Trainer] = None
-        self.state = None
-        self._membership_version = -1
-        self._rank = 0
-        self._ranks: Dict[str, int] = {}
-        self._addresses: Dict[str, str] = {}
+        # The trainer/state pair is REPLACED only by the task loop
+        # (membership reform, restore); checkpoint/prep threads read
+        # the reference they were spawned with (happens-before via
+        # thread start / _join_ckpt).
+        self.trainer: Optional[Trainer] = None  # single-writer: main
+        self.state = None  # single-writer: main
+        self._membership_version = -1  # single-writer: main (the beat reads one int)
+        self._rank = 0  # single-writer: main (reform happens on the task loop)
+        # Replaced wholesale (fresh dicts) on reform; beat-thread readers
+        # see either the old or the new reference, never a mid-mutation.
+        self._ranks: Dict[str, int] = {}  # single-writer: main
+        self._addresses: Dict[str, str] = {}  # single-writer: main
         # Multi-host lockstep: all processes of the world walk the master's
         # group task log in the same order (GetGroupTask seq counter); only
         # rank 0 reports results.
-        self._group_mode = False
+        self._group_mode = False  # single-writer: main
         self._task_seq = 0
         # Gang-boundary ARRIVAL counter (r13, the deadline-bounded gang
         # boundary's per-rank progress signal): group-log entries whose
@@ -190,7 +196,7 @@ class Worker:
         # collective retry (_retry_transient_collective re-dispatches the
         # SAME entry): a retried rank must not drift ahead of its peers,
         # or the deadline would read every HEALTHY rank as the laggard.
-        self._gang_dispatched = 0
+        self._gang_dispatched = 0  # single-writer: main (beat reads a recent value)
         self._gang_last_task = -1
         self._ckpt: Optional[CheckpointManager] = None
         # Checkpoint watermark + background-save thread handle: touched by
@@ -229,14 +235,14 @@ class Worker:
         self._tasks_done = 0
         # Python-side step counter mirroring state.step: reading the device
         # scalar would drain the dispatch pipeline at every task boundary.
-        self._steps_dispatched = 0
+        self._steps_dispatched = 0  # single-writer: main (prep/ckpt threads read a recent value)
         # Set by preemption_snapshot (SIGTERM thread): the task loop parks
         # at its next boundary instead of dispatching more work, so the
         # live state leaves the donated-in-flight window and can be saved.
         # _parked acknowledges the park — once True, the loop only sleeps,
         # so self.state can no longer be donated or reassigned.
-        self._preempting = False
-        self._parked = False
+        self._preempting = False  # single-writer: thread:preemption
+        self._parked = False  # single-writer: main (the preemption thread spin-reads it)
         # Background periodic-checkpoint machinery (_save_snapshot_background
         # / _save_group_snapshot_background)
         self._ckpt_thread = None  # guarded-by: _ckpt_lock
@@ -270,7 +276,10 @@ class Worker:
         # never starves.  Benign race between the loop beat and the
         # background liveness beat: worst case one extra snapshot.
         self._gauge_ship_interval_s = 1.0
-        self._last_gauge_ship = 0.0
+        # Ship throttle: a cross-thread TOCTOU double-ship is harmless
+        # (the fleet view banks the newest snapshot), so single-op
+        # atomicity is the whole consistency story.
+        self._last_gauge_ship = 0.0  # gil-atomic
         # Per-phase wall decomposition of the task loop (common/metrics.py
         # PhaseTimers); snapshots ride every report so the master and the
         # train-job artifact can attribute the job-vs-bench gap to named
@@ -287,7 +296,7 @@ class Worker:
             trace.configure(
                 enabled=True, capacity=config.trace_buffer_events
             )
-        self._trace_clock_offset_us: Optional[float] = None
+        self._trace_clock_offset_us: Optional[float] = None  # single-writer: main (beat readers tolerate one stale estimate)
         # graftchaos (chaos/inject.py): the --chaos fault plan rides the
         # config bus exactly like --trace; faults address this process by
         # worker id or rank (set_context keeps the rank current across
@@ -555,6 +564,9 @@ class Worker:
         )
         return self.trainer.adopt_restored(restored)
 
+    # thread-role: thread:heartbeat — the beat thread (worker.main _beat)
+    # reaches this through the worker holder dict, a hand-off the static
+    # resolver cannot see.
     def death_watch_tick(
         self, state: dict, now: float, master_version=None
     ) -> bool:
@@ -628,6 +640,8 @@ class Worker:
         )
         return True
 
+    # thread-role: thread:heartbeat — ditto: invoked from the beat thread
+    # via the worker holder.
     def gang_beat_fields(self) -> dict:
         """Fields the background liveness beat (worker.main ``_beat``)
         adds to its Heartbeat so the deadline-bounded gang boundary keeps
@@ -688,6 +702,8 @@ class Worker:
                 labels={"phase": name},
             ).set(float(n))
 
+    # thread-role: thread:heartbeat — also shipped by the beat thread
+    # (besides the loop heartbeat and checkpoint reports).
     def gauge_payload(self, force: bool = False) -> Optional[dict]:
         """The Heartbeat/Report ``gauge`` envelope: this worker's full
         registry snapshot (collectors run, so depths and phase families
@@ -986,6 +1002,8 @@ class Worker:
             self._ckpt_thread = t
         t.start()
 
+    # thread-role: thread:preemption — runs on the SIGTERM handler's
+    # graceful-exit thread (worker.main), reached via the worker holder.
     def preemption_snapshot(self) -> bool:
         """Best-effort state save on SIGTERM (k8s preemption grace window).
 
@@ -1753,6 +1771,7 @@ class Worker:
             else:
                 self._report_result(report)
         if report["success"]:
+            # graftlint: allow[shared-state] the _parked spin-wait handshake serializes the preemption thread's _flush_pending against the loop (see preemption_snapshot)
             self._tasks_done += 1
             self._g_tasks.inc()
             self._maybe_checkpoint()
@@ -1858,6 +1877,7 @@ class Worker:
         self._steps_dispatched += n_steps
         report["model_version"] = self._steps_dispatched
         self._training_tasks_done += 1
+        # graftlint: allow[shared-state] the _parked spin-wait handshake serializes the preemption thread's _flush_pending against this swap (see preemption_snapshot)
         prev, self._pending = self._pending, (report, metrics_list)
         try:
             self._flush(prev)

@@ -26,6 +26,14 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # developer can force it off with GRAFT_LOCKSAN=0.
 os.environ.setdefault("GRAFT_LOCKSAN", "1")
 
+# Runtime shared-state sanitizer (common/racesan.py) ON for the whole
+# tier-1 suite, the locksan pattern: opted-in control-plane classes record
+# per-attribute (thread-role, held-locks) observations and raise on a
+# cross-role unguarded write — the dynamic twin of graftlint's v5
+# shared-state pass.  Must be set before the opted-in classes are
+# imported (the decorator reads it at class-creation time).
+os.environ.setdefault("GRAFT_RACESAN", "1")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

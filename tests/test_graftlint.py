@@ -1308,3 +1308,572 @@ def test_cli_changed_mode_runs(tmp_path):
     )
     assert out.returncode in (0, 1), out.stderr
     json.loads(out.stdout)  # valid JSON either way
+
+
+# ---- thread-hygiene v5: Timer + executor shapes ----
+
+def test_timer_seeded_and_clean_twins():
+    seeded = """
+        import threading
+
+        def fire():
+            threading.Timer(5.0, print).start()
+    """
+    findings = _lint(seeded, [ThreadHygienePass()])
+    assert _rules(findings) == {"thread-hygiene"}
+    assert "Timer" in findings[0].message
+    clean = """
+        import threading
+
+        def daemonized():
+            t = threading.Timer(5.0, print)
+            t.daemon = True
+            t.start()
+
+        def cancelled():
+            t = threading.Timer(5.0, print)
+            t.start()
+            t.cancel()
+
+        def joined():
+            t = threading.Timer(0.0, print)
+            t.start()
+            t.join()
+    """
+    assert _lint(clean, [ThreadHygienePass()]) == []
+
+
+def test_executor_seeded_and_clean_twins():
+    seeded = """
+        from concurrent.futures import ThreadPoolExecutor
+
+        def leak():
+            pool = ThreadPoolExecutor(4)
+            pool.submit(print)
+    """
+    findings = _lint(seeded, [ThreadHygienePass()])
+    assert _rules(findings) == {"thread-hygiene"}
+    assert "executor" in findings[0].message
+    clean = """
+        from concurrent.futures import ThreadPoolExecutor, futures
+
+        class Owner:
+            def __init__(self, par):
+                # Conditional construction still counts as owned.
+                self._pool = ThreadPoolExecutor(4) if par else None
+
+        def handed_to_owner(grpc):
+            return grpc.server(ThreadPoolExecutor(8))
+
+        def scoped():
+            with ThreadPoolExecutor(2) as pool:
+                pool.submit(print)
+
+        def shut_down():
+            pool = ThreadPoolExecutor(2)
+            pool.submit(print)
+            pool.shutdown()
+    """
+    assert _lint(clean, [ThreadHygienePass()]) == []
+
+
+# ---- thread-map (v5) ----
+
+def _tmap(files: dict):
+    from elasticdl_tpu.analysis.thread_map import shared_thread_map
+
+    return shared_thread_map(_sources(files))
+
+
+THREADED_MODULE = """
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    class W:
+        def __init__(self):
+            self._pool = ThreadPoolExecutor(2)
+
+        def start(self):
+            threading.Thread(
+                target=self._watch, name="watcher", daemon=True
+            ).start()
+            threading.Timer(1.0, self._retry).start()
+            fut = self._pool.submit(self._prep, 1)
+            fut.add_done_callback(self._done)
+
+        def _watch(self):
+            self._tick()
+
+        def _tick(self):
+            pass
+
+        def _retry(self):
+            pass
+
+        def _prep(self, n):
+            pass
+
+        def _done(self, fut):
+            pass
+
+        def loop(self):
+            pass
+
+
+    def main():
+        w = W()
+        w.loop()
+"""
+
+
+def test_thread_map_infers_spawn_shapes_and_propagates():
+    tmap = _tmap({"pkg/__init__.py": "", "pkg/mod.py": THREADED_MODULE})
+    roles = {
+        q.split(":")[-1]: sorted(r) for q, r in tmap.roles.items()
+    }
+    assert roles["W._watch"] == ["thread:watcher"]
+    # Propagated over the call edge, not just the entry.
+    assert roles["W._tick"] == ["thread:watcher"]
+    assert roles["W._retry"] == ["timer:_retry"]
+    assert roles["W._prep"] == ["pool:_prep"]
+    assert roles["W._done"] == ["callback:_done"]
+    # Constructor-typed local: main's `w = W(); w.loop()` edges into W.loop.
+    assert roles["W.loop"] == ["main"]
+    # start() itself has no inferred role (nothing spawns INTO it).
+    assert "W.start" not in roles
+
+
+def test_thread_map_closure_target_and_inheritance():
+    tmap = _tmap({"pkg/__init__.py": "", "pkg/mod.py": """
+        import threading
+
+        def main():
+            def bg():
+                helper()
+
+            def inline():
+                helper2()
+
+            threading.Thread(target=bg, daemon=True).start()
+            inline()
+
+        def helper():
+            pass
+
+        def helper2():
+            pass
+    """})
+    by_fn = {q.split(":")[-1]: sorted(r) for q, r in tmap.roles.items()}
+    # The spawned closure runs ONLY on its thread; calls propagate.
+    assert by_fn["helper"] == ["thread:bg"]
+    # A non-spawned closure inherits the enclosing function's role.
+    assert by_fn["helper2"] == ["main"]
+
+
+def test_thread_map_grpc_method_table_and_dict_literal():
+    tmap = _tmap({"pkg/__init__.py": "", "pkg/svc.py": """
+        import grpc
+
+        class FooServicer:
+            def method_table(self):
+                return {name: getattr(self, name) for name in ("GetTask",)}
+
+            def GetTask(self, req):
+                return self._inner()
+
+            def _inner(self):
+                pass
+
+        class Shard:
+            def __init__(self):
+                self._server = grpc.server(None)
+                self._server.add_generic_rpc_handlers(())
+
+            def _make(self):
+                return {"Pull": self._pull}
+
+            def _pull(self, req):
+                pass
+    """})
+    by_fn = {q.split(":")[-1]: sorted(r) for q, r in tmap.roles.items()}
+    assert by_fn["FooServicer.GetTask"] == ["grpc:FooServicer"]
+    assert by_fn["FooServicer._inner"] == ["grpc:FooServicer"]
+    assert by_fn["Shard._pull"] == ["grpc:Shard"]
+    # An ordinary dispatch table in a non-grpc class is NOT an entry.
+    tmap2 = _tmap({"pkg/__init__.py": "", "pkg/plain.py": """
+        class Plain:
+            def table(self):
+                return {"a": self._a}
+
+            def _a(self):
+                pass
+    """})
+    assert not any("grpc" in r for rs in tmap2.roles.values() for r in rs)
+
+
+def test_thread_role_annotation_seeds_and_malformed_is_finding():
+    from elasticdl_tpu.analysis.shared_state import SharedStatePass
+
+    tmap = _tmap({"pkg/__init__.py": "", "pkg/mod.py": """
+        class W:
+            # thread-role: thread:beat — reached through a holder dict
+            def tick(self):
+                pass
+    """})
+    by_fn = {q.split(":")[-1]: sorted(r) for q, r in tmap.roles.items()}
+    assert by_fn["W.tick"] == ["thread:beat"]
+    findings = _lint("""
+        class W:
+            # thread-role: !!nope
+            def tick(self):
+                pass
+    """, [SharedStatePass()])
+    assert _rules(findings) == {"shared-state"}
+    assert "malformed thread-role" in findings[0].message
+
+
+# ---- shared-state (v5) ----
+
+SHARED_SEEDED = """
+    import threading
+
+    class W:
+        def __init__(self):
+            self._depth = 0
+
+        def run(self):
+            self._depth = 1
+
+        def start(self):
+            threading.Thread(target=self._bg, daemon=True).start()
+
+        def _bg(self):
+            self._depth = 2
+
+
+    def main():
+        w = W()
+        w.run()
+"""
+
+SHARED_CLEAN = """
+    import threading
+
+    class W:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._depth = 0
+
+        def run(self):
+            with self._lock:
+                self._depth = 1
+
+        def start(self):
+            threading.Thread(target=self._bg, daemon=True).start()
+
+        def _bg(self):
+            with self._lock:
+                self._depth = 2
+
+
+    def main():
+        w = W()
+        w.run()
+"""
+
+
+def test_shared_state_cross_role_unguarded_write():
+    from elasticdl_tpu.analysis.shared_state import SharedStatePass
+
+    findings = _lint(SHARED_SEEDED, [SharedStatePass()])
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "shared-state"
+    assert "_depth" in f.message and "thread:_bg" in f.message
+    assert "main" in f.message
+
+
+def test_shared_state_clean_twin_common_lock():
+    from elasticdl_tpu.analysis.shared_state import SharedStatePass
+
+    assert _lint(SHARED_CLEAN, [SharedStatePass()]) == []
+
+
+def test_shared_state_guarded_by_helper_annotation_counts_as_held():
+    # The *_locked helper convention: a def-line '# guarded-by: <lock>'
+    # marks the lock held by contract, so the helper's sites share it.
+    from elasticdl_tpu.analysis.shared_state import SharedStatePass
+
+    src = """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._depth = 0
+
+            def run(self):
+                with self._lock:
+                    self._bump_locked()
+
+            def _bump_locked(self):  # guarded-by: _lock
+                self._depth = 1
+
+            def start(self):
+                threading.Thread(target=self._bg, daemon=True).start()
+
+            def _bg(self):
+                with self._lock:
+                    self._depth = 2
+
+
+        def main():
+            w = W()
+            w.run()
+    """
+    assert _lint(src, [SharedStatePass()]) == []
+
+
+def test_shared_state_init_and_roleless_sites_exempt():
+    from elasticdl_tpu.analysis.shared_state import SharedStatePass
+
+    src = """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._cfg = {}
+
+            def helper_nobody_calls(self):
+                self._cfg = {"x": 1}
+
+            def start(self):
+                threading.Thread(target=self._bg, daemon=True).start()
+
+            def _bg(self):
+                print(self._cfg)
+    """
+    # The only roled site is the _bg read; writes are __init__ (exempt)
+    # and an unreachable helper (unknown role): no finding.
+    assert _lint(src, [SharedStatePass()]) == []
+
+
+def test_shared_state_single_writer_declared_and_violated():
+    from elasticdl_tpu.analysis.shared_state import SharedStatePass
+
+    clean = """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._step = 0  # single-writer: main
+
+            def run(self):
+                self._step += 1
+
+            def start(self):
+                threading.Thread(target=self._bg, daemon=True).start()
+
+            def _bg(self):
+                print(self._step)
+
+
+        def main():
+            w = W()
+            w.run()
+    """
+    assert _lint(clean, [SharedStatePass()]) == []
+    violated = clean.replace(
+        "def _bg(self):\n                print(self._step)",
+        "def _bg(self):\n                self._step = 9",
+    )
+    findings = _lint(violated, [SharedStatePass()])
+    assert len(findings) == 1
+    assert "single-writer" in findings[0].message
+    assert "thread:_bg" in findings[0].message
+
+
+def test_shared_state_single_writer_unknown_role_is_finding():
+    from elasticdl_tpu.analysis.shared_state import SharedStatePass
+
+    src = """
+        class W:
+            def __init__(self):
+                self._step = 0  # single-writer: thread:nope
+    """
+    findings = _lint(src, [SharedStatePass()])
+    assert len(findings) == 1
+    assert "unknown role" in findings[0].message
+
+
+def test_shared_state_gil_atomic_and_rmw_violation():
+    from elasticdl_tpu.analysis.shared_state import SharedStatePass
+
+    clean = """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._last = 0.0  # gil-atomic
+
+            def run(self):
+                self._last = 1.0
+
+            def start(self):
+                threading.Thread(target=self._bg, daemon=True).start()
+
+            def _bg(self):
+                self._last = 2.0
+
+
+        def main():
+            w = W()
+            w.run()
+    """
+    assert _lint(clean, [SharedStatePass()]) == []
+    violated = clean.replace("self._last = 2.0", "self._last += 2.0")
+    findings = _lint(violated, [SharedStatePass()])
+    assert len(findings) == 1
+    assert "read-modify-write" in findings[0].message
+
+
+def test_shared_state_waivable_with_reason():
+    from elasticdl_tpu.analysis.shared_state import SharedStatePass
+
+    src = SHARED_SEEDED.replace(
+        "        def run(self):\n            self._depth = 1",
+        "        def run(self):\n"
+        "            # graftlint: allow[shared-state] benign telemetry value;"
+        " a torn read costs one stale sample\n"
+        "            self._depth = 1",
+    )
+    assert _lint(src, [SharedStatePass()]) == []
+
+
+def test_shared_state_full_suite_keeps_waiver_live():
+    # The waiver must neither be bypassed nor flagged stale by the full
+    # pass suite (the r7/r8 adoption pattern).
+    src = SHARED_SEEDED.replace(
+        "        def run(self):\n            self._depth = 1",
+        "        def run(self):\n"
+        "            # graftlint: allow[shared-state] benign telemetry value;"
+        " a torn read costs one stale sample\n"
+        "            self._depth = 1",
+    )
+    assert _lint(src, all_passes()) == []
+
+
+# ---- --threadmap CLI ----
+
+def test_cli_threadmap_dump():
+    out = subprocess.run(
+        [sys.executable, "tools/graftlint.py", "elasticdl_tpu", "--threadmap"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stderr
+    doc = json.loads(out.stdout)
+    assert doc["functions_with_role"] > 100
+    assert "grpc:MasterServicer" in doc["roles"]
+    assert any(
+        "Worker._prep_fused_host" in q
+        for q in doc["roles"].get("pool:_prep_fused_host", [])
+    )
+    assert "thread:heartbeat" in doc["roles"]
+    kinds = {e["kind"] for e in doc["entries"]}
+    assert {"thread", "timer", "pool", "grpc", "main", "annotation"} <= kinds
+
+
+def test_cli_artifact_has_thread_map_stats(tmp_path):
+    art = tmp_path / "LINT_test.json"
+    out = subprocess.run(
+        [
+            sys.executable, "tools/graftlint.py", "elasticdl_tpu", "tools",
+            "--artifact", str(art),
+        ],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    rec = json.loads(art.read_text())
+    assert rec["metric"] == "lint_findings"
+    tm = rec["thread_map"]
+    assert tm["roles"] > 10 and tm["entries"] > 20
+    assert 0 < tm["functions_with_role"] <= tm["functions_total"]
+    assert tm["entries_by_kind"]["grpc"] >= 15
+    assert "shared-state" in rec["rules"]
+
+
+def test_shared_state_container_mutation_is_a_write():
+    # self._counts[k] += 1 mutates the SHARED CONTAINER through the
+    # attribute — the _known_workers-style check-and-set must flag even
+    # though no attribute rebind ever happens.
+    from elasticdl_tpu.analysis.shared_state import SharedStatePass
+
+    src = """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._counts = {}
+
+            def run(self):
+                self._counts["k"] = self._counts.get("k", 0) + 1
+
+            def start(self):
+                threading.Thread(target=self._bg, daemon=True).start()
+
+            def _bg(self):
+                self._counts["k"] = 0
+
+
+        def main():
+            w = W()
+            w.run()
+    """
+    findings = _lint(src, [SharedStatePass()])
+    assert len(findings) == 1 and "_counts" in findings[0].message
+    # And an augmented item assignment is a read-modify-write: illegal
+    # under gil-atomic.
+    aug = src.replace(
+        'self._counts["k"] = self._counts.get("k", 0) + 1',
+        'self._counts["k"] += 1',
+    ).replace(
+        "self._counts = {}",
+        "self._counts = {}  # gil-atomic",
+    )
+    findings = _lint(aug, [SharedStatePass()])
+    assert len(findings) == 1
+    assert "read-modify-write" in findings[0].message
+
+
+def test_shared_state_same_role_unlocked_read_not_flagged():
+    # The writer role's own unlocked read cannot race writes it is
+    # sequenced with: the judgement is per cross-role PAIR, not a global
+    # all-site lock intersection.
+    from elasticdl_tpu.analysis.shared_state import SharedStatePass
+
+    src = """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._depth = 0
+
+            def run(self):
+                with self._lock:
+                    self._depth = 1
+                print(self._depth)  # same role as the sole writer: safe
+
+            def start(self):
+                threading.Thread(target=self._bg, daemon=True).start()
+
+            def _bg(self):
+                with self._lock:
+                    print(self._depth)
+
+
+        def main():
+            w = W()
+            w.run()
+    """
+    assert _lint(src, [SharedStatePass()]) == []

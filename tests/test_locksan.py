@@ -178,3 +178,57 @@ def test_violation_reports_first_witness_site():
         assert "test_locksan.py" in str(e)
     else:
         pytest.fail("inversion not raised")
+
+
+# ---- contention stats (r16) ----
+
+def test_contention_stats_off_by_default_and_opt_in():
+    lk = locksan.lock("Stat.cold")
+    with lk:
+        pass
+    # reset() in the fixture cleared stats AND the enable flag persists
+    # process-wide once a collector installs it; judge only the per-name
+    # aggregates here.
+    locksan.enable_contention_stats((1.0, 10.0))
+    with lk:
+        pass
+    snap = locksan.contention_snapshot()
+    assert "Stat.cold" in snap
+    rec = snap["Stat.cold"]
+    assert rec["acquires"] == 1
+    wm = rec["wait_ms"]
+    assert wm["edges"] == [1.0, 10.0]
+    assert len(wm["counts"]) == 3 and sum(wm["counts"]) == 1
+    assert wm["count"] == 1
+    # An uncontended acquire waits ~0 ms: the under-first-edge bin.
+    assert wm["counts"][0] == 1
+
+
+def test_contention_stats_measure_blocked_wait():
+    locksan.enable_contention_stats((1.0, 10.0, 100.0))
+    lk = locksan.lock("Stat.busy")
+    lk.acquire()
+    release_timer = threading.Timer(0.05, lk.release)
+    release_timer.daemon = True
+
+    def contender():
+        release_timer.start()
+        with lk:  # blocks ~50 ms until the timer releases
+            pass
+
+    t = threading.Thread(target=contender, daemon=True)
+    t.start()
+    t.join(10.0)
+    assert not t.is_alive()
+    rec = locksan.contention_snapshot()["Stat.busy"]
+    assert rec["acquires"] == 2
+    assert rec["wait_ms"]["sum"] >= 40.0  # the blocked acquire's wait
+
+
+def test_reset_clears_contention_stats():
+    locksan.enable_contention_stats((1.0,))
+    with locksan.lock("Stat.reset"):
+        pass
+    assert "Stat.reset" in locksan.contention_snapshot()
+    locksan.reset()
+    assert locksan.contention_snapshot() == {}

@@ -145,6 +145,20 @@ EXTRACTORS = {
             c.get("examples_per_sec_per_chip"), HIGHER)
         for c in d.get("configs") or [] if isinstance(c, dict)
     },
+    # graftlint (r16): the trajectory gate covers LINT DEBT too — the
+    # repo-wide findings count must only ever go down (it is 0 at every
+    # shipped rev; any increase is a regression against a zero baseline).
+    "lint_findings": lambda d: {
+        "findings": (d.get("findings"), LOWER),
+    },
+}
+
+#: Family-name fallback extractors for artifacts that predate their
+#: ``metric`` field — the LINT_r07..r15 files carry ``findings`` but no
+#: metric key, and the lint-debt series is only a trajectory if the old
+#: revs index too.
+FAMILY_EXTRACTORS = {
+    "LINT": EXTRACTORS["lint_findings"],
 }
 
 #: Keys that define "same pipeline config".  Two points compare only when
@@ -185,7 +199,9 @@ def index_artifacts(repo: str = _REPO_ROOT) -> List[dict]:
         if not isinstance(d, dict):
             continue
         family, rev = parse_name(path)
-        extractor = EXTRACTORS.get(d.get("metric"))
+        extractor = EXTRACTORS.get(d.get("metric")) or FAMILY_EXTRACTORS.get(
+            family
+        )
         metrics: Dict[str, dict] = {}
         if extractor is not None:
             for name, (value, direction) in extractor(d).items():
@@ -245,7 +261,25 @@ def build_trajectory(entries: List[dict], threshold_pct: float) -> dict:
             if not configs_comparable(latest["config"], prev["config"]):
                 slot["status"] = "config_changed"
             elif prev["value"] == 0:
-                slot["status"] = "zero-baseline"
+                # A zero baseline has no meaningful ratio — EXCEPT for
+                # lower-is-better counts (lint findings), where any climb
+                # off zero is a regression outright (delta vs a floor of
+                # 1 keeps the number finite and honest in scale).
+                if slot["direction"] == LOWER and latest["value"] > 0:
+                    slot["status"] = "REGRESSED"
+                    slot["latest_delta_pct"] = round(
+                        -latest["value"] * 100.0, 2
+                    )
+                    regressions.append({
+                        "family": slot["family"], "name": slot["name"],
+                        "delta_pct": slot["latest_delta_pct"],
+                        "from": {"rev": revs[-2], **{
+                            k: prev[k] for k in ("value", "file")}},
+                        "to": {"rev": revs[-1], **{
+                            k: latest[k] for k in ("value", "file")}},
+                    })
+                else:
+                    slot["status"] = "zero-baseline"
             else:
                 delta = (latest["value"] - prev["value"]) / abs(prev["value"])
                 if slot["direction"] == LOWER:

@@ -5,6 +5,7 @@ Usage:
     python tools/graftlint.py --changed          # git-diff-scoped fast mode
     python tools/graftlint.py --json             # findings + waiver inventory
     python tools/graftlint.py --callgraph        # dump the v2 call/lock graph
+    python tools/graftlint.py --threadmap        # dump the v5 role map
     python tools/graftlint.py --artifact [PATH]  # stamp LINT artifact
     python tools/graftlint.py --list-rules
 
@@ -39,7 +40,7 @@ if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
 DEFAULT_PATHS = ("elasticdl_tpu", "tools")
-ARTIFACT_NAME = "LINT_r15.json"
+ARTIFACT_NAME = "LINT_r16.json"
 
 
 def _changed_files(repo: str) -> Optional[List[str]]:
@@ -97,6 +98,14 @@ def _callgraph_dump(sources) -> dict:
     }
 
 
+def _threadmap_dump(sources) -> dict:
+    """The v5 role model, machine-readable: role -> functions plus the
+    inferred entry points (``--threadmap``, mirroring ``--callgraph``)."""
+    from elasticdl_tpu.analysis.thread_map import shared_thread_map
+
+    return shared_thread_map(sources).dump()
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="graftlint", description=__doc__,
@@ -120,6 +129,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--callgraph", action="store_true",
         help="dump the interprocedural model (functions, blocking roots, "
         "lock graph) as JSON and exit",
+    )
+    parser.add_argument(
+        "--threadmap", action="store_true",
+        help="dump the v5 thread-role map (role -> functions, entry "
+        "points) as JSON and exit",
     )
     parser.add_argument(
         "--artifact", nargs="?", const="", default=None, metavar="PATH",
@@ -181,7 +195,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         # Project-wide passes judge whole-graph properties: re-lint every
         # module that imports a changed one, or a helper edit could break
         # an unchanged root silently (import-hygiene chains, lock-order
-        # edges, blocking propagation all cross module boundaries).
+        # edges, blocking propagation — and since v5, thread-role
+        # propagation and shared-state judgements, whose typed call edges
+        # ride the same import graph — all cross module boundaries).
         from elasticdl_tpu.analysis.core import load_sources
         from elasticdl_tpu.analysis.import_hygiene import module_dependents
 
@@ -196,12 +212,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     waivers = collect_waivers(sources, only_paths=only_paths)
 
-    if args.callgraph:
+    if args.callgraph or args.threadmap:
         # Findings still gate the exit code — render them (stderr, so the
         # stdout JSON stays parseable) or a failing dump is undiagnosable.
         for f in findings:
             print(f.render(), file=sys.stderr)
-        print(json.dumps(_callgraph_dump(sources), indent=1, sort_keys=True))
+        dump = (
+            _callgraph_dump(sources) if args.callgraph
+            else _threadmap_dump(sources)
+        )
+        print(json.dumps(dump, indent=1, sort_keys=True))
         return 1 if findings else 0
 
     if args.as_json:
@@ -230,8 +250,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         by_rule = Counter(f.rule for f in findings)
         waivers_by_rule = Counter(w["rule"] for w in waivers)
         cg = _callgraph_dump(sources)
+        tm = _threadmap_dump(sources)
         write_artifact(
             {
+                # The trajectory gate (tools/bench_regress.py) indexes
+                # this family by findings count, direction=down.
+                "metric": "lint_findings",
                 "findings": len(findings),
                 "by_rule": dict(sorted(by_rule.items())),
                 "waivers": len(waivers),
@@ -256,6 +280,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                     ],
                 },
                 "hot_path_functions": len(cg["hot_path_functions"]),
+                "thread_map": {
+                    "roles": len(tm["roles"]),
+                    "entries": len(tm["entries"]),
+                    "functions_with_role": tm["functions_with_role"],
+                    "functions_total": tm["functions_total"],
+                    "entries_by_kind": dict(sorted(Counter(
+                        e["kind"] for e in tm["entries"]
+                    ).items())),
+                },
                 "code_rev": code_rev(),
             },
             ARTIFACT_NAME,

@@ -186,6 +186,36 @@ def render_collectives(
     return "collectives: " + " ".join(parts)
 
 
+def render_locks(families: Dict[str, dict], top: int = 3) -> Optional[str]:
+    """One summary line for the locksan contention families (r16) —
+    total sanitized acquires plus the ``top`` locks by p99 wait — or None
+    when the endpoint serves none (sanitizer off, or an old build).  The
+    full per-lock histogram still renders in the table below."""
+    acquires = _scalar_sum(families, "edl_lock_acquire_total")
+    hist = families.get("edl_lock_wait_ms")
+    if acquires is None and hist is None:
+        return None
+    parts = []
+    if acquires is not None:
+        parts.append(f"acquires={acquires:.0f}")
+    if hist is not None:
+        keys = sorted({
+            tuple(sorted(
+                (k, v) for k, v in s["labels"].items() if k != "le"
+            ))
+            for s in hist["samples"]
+        })
+        waits = []
+        for key in keys:
+            count, _total, _p50, p99 = _hist_stats(hist["samples"], key)
+            if count > 0 and p99 is not None:
+                name = dict(key).get("lock", "?")
+                waits.append((p99, name))
+        for p99, name in sorted(waits, reverse=True)[:top]:
+            parts.append(f"{name} p99~{p99:.2f}ms")
+    return "locks: " + " ".join(parts)
+
+
 def render_table(families: Dict[str, dict],
                  prefixes: Optional[List[str]] = None) -> str:
     """One aligned line per series; histograms summarize to
@@ -274,6 +304,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             )
             if summary:
                 print(summary)
+            locks = render_locks(families)
+            if locks:
+                print(locks)
             print(render_table(families))
         state["prev"], state["t"] = families, now
 
