@@ -69,6 +69,27 @@ over call edges plus a constructor-type layer) and on top of it:
                             ``# gil-atomic`` (illegal on read-modify-
                             write sites)
 
+v6 adds compile & transfer discipline (``analysis/jit_discipline.py``):
+
+- ``jit-shim``          raw ``jax.jit``/``jax.pjit`` only in
+                        ``common/jax_compat.py``; ``jit_compiled``/
+                        ``jit_donating`` call sites declare ``name=``
+                        (the jitsan registry / gauge-label key)
+- ``jit-stability``     a jit created inside a per-call function body or
+                        loop and invoked there rebuilds its compile
+                        cache every invocation — bind module-level,
+                        memoize on ``self.<attr>``, or return it
+                        (builder pattern)
+- ``transfer-discipline``  device->host materializations (``.item()``,
+                        ``.tolist()``, ``jax.device_get``,
+                        ``np.asarray``, ``int()``/``float()``) of values
+                        flowing from a ``# jit-boundary`` function must
+                        not be reachable from ``# hot-path`` functions
+                        outside a ``phases.phase(...)`` boundary —
+                        resolved over the v2/v5 call graph, with
+                        materializing helpers propagating to hot
+                        callers like ``blocking-propagation``
+
 The runtime twin of ``lock-order`` is ``common/locksan.py``: a debug lock
 wrapper that records actual acquisition orders under ``GRAFT_LOCKSAN=1``
 (on for tier-1 via tests/conftest.py) and raises on inversions or
@@ -100,6 +121,11 @@ from elasticdl_tpu.analysis.core import (  # noqa: F401
 from elasticdl_tpu.analysis.gauge_discipline import GaugeDisciplinePass
 from elasticdl_tpu.analysis.hot_path import HotPathSyncPass
 from elasticdl_tpu.analysis.import_hygiene import ImportHygienePass
+from elasticdl_tpu.analysis.jit_discipline import (
+    JitShimPass,
+    JitStabilityPass,
+    TransferDisciplinePass,
+)
 from elasticdl_tpu.analysis.lock_discipline import LockDisciplinePass
 from elasticdl_tpu.analysis.lock_order import LockOrderPass
 from elasticdl_tpu.analysis.rpc_discipline import RpcDisciplinePass
@@ -125,4 +151,7 @@ def all_passes() -> list:
         TraceDisciplinePass(),
         ChaosDisciplinePass(),
         GaugeDisciplinePass(),
+        JitShimPass(),
+        JitStabilityPass(),
+        TransferDisciplinePass(),
     ]

@@ -150,6 +150,24 @@ def _chain(node: ast.AST) -> str:
     return ""
 
 
+def partial_target(node: ast.AST) -> Optional[ast.expr]:
+    """``functools.partial(T, ...)`` / ``partial(T, ...)`` -> the wrapped
+    callable expression ``T``, else None.  Shared by the thread map (v6):
+    a partial handed to ``Thread(target=...)`` / ``pool.submit(...)``
+    executes its wrapped callable on the spawned thread, so the role
+    resolver must see through it — before v6, partial-wrapped targets got
+    no role, silently muting shared-state checks on everything they
+    touch.  Only the two canonical spellings match (``functools.partial``
+    and a bare ``partial`` import); an arbitrary ``obj.partial(...)``
+    method stays dynamic."""
+    if not isinstance(node, ast.Call):
+        return None
+    chain = _chain(node.func)
+    if chain in ("partial", "functools.partial") and node.args:
+        return node.args[0]
+    return None
+
+
 def _lock_ctor(node: ast.AST) -> Optional[Tuple[bool, bool]]:
     """(is_lock, reentrant) when ``node`` is a lock-constructor call."""
     if not isinstance(node, ast.Call):

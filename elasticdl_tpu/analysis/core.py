@@ -52,6 +52,14 @@ KNOWN_RULES = {
     # '# thread-role:' / '# single-writer:' / '# gil-atomic' annotation
     # grammar, which the pass validates itself.
     "shared-state",
+    # v6: compile & transfer discipline (analysis/jit_discipline.py) —
+    # raw jax.jit only in the shim (with name= declared at shim call
+    # sites), no fresh-compile-cache-per-invocation jit bindings, and no
+    # device->host materialization of jit-boundary values reachable from
+    # '# hot-path' functions.  Runtime twin: common/jitsan.py.
+    "jit-shim",
+    "jit-stability",
+    "transfer-discipline",
     # A waiver that suppresses no finding is itself a finding: the waiver
     # inventory must not rot as code moves (see run_passes).
     "stale-waiver",

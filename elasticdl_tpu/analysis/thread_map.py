@@ -25,6 +25,10 @@ A *role* is a named concurrency domain.  Entry points seed roles:
   to ``self.<method>`` inside a class that wires grpc handlers
   (ps/service.py, serving/server.py);
 - a module-level ``def main(...)``           -> ``main`` (the task loop);
+- ``functools.partial(T, ...)`` (or a bare ``partial`` import) in any of
+  the spawn shapes above unwraps to ``T`` (v6 — previously a documented
+  blind spot: partial-wrapped targets got no role, muting shared-state
+  checks on everything they touch);
 - ``# thread-role: <role>`` on a ``def`` line (or the comment-only line
   above) — the explicit seed for hand-offs the resolver cannot see
   (e.g. a worker handed to the beat thread through a holder dict).
@@ -54,7 +58,7 @@ import ast
 import re
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
-from elasticdl_tpu.analysis.callgraph import CallGraph, shared_graph
+from elasticdl_tpu.analysis.callgraph import CallGraph, partial_target, shared_graph
 from elasticdl_tpu.analysis.core import Finding, SourceFile, attr_chain as _attr_chain
 from elasticdl_tpu.analysis.import_hygiene import _module_name
 
@@ -97,6 +101,9 @@ class ThreadEntry:
 
 def _short_name(node: ast.expr) -> str:
     """Display name of a spawn target expression."""
+    inner = partial_target(node)
+    if inner is not None:
+        return _short_name(inner)  # partial(T, ...): T names the role
     if isinstance(node, ast.Attribute):
         return node.attr
     if isinstance(node, ast.Name):
@@ -392,6 +399,12 @@ class ThreadMap:
         self, mod, cls, q, node: ast.expr, local_types
     ) -> Optional[str]:
         """A spawn-target expression -> qualname, or None (dynamic)."""
+        inner = partial_target(node)
+        if inner is not None:
+            # functools.partial(T, ...): the spawned thread runs T —
+            # resolve the wrapped callable (v6; previously a documented
+            # blind spot that muted shared-state checks on T).
+            return self._resolve_target(mod, cls, q, inner, local_types)
         if isinstance(node, ast.Lambda):
             return f"{q}.<lambda@{node.lineno}>"
         if isinstance(node, ast.Name):
@@ -475,6 +488,13 @@ class ThreadMap:
 
     def roles_of(self, qualname: str) -> frozenset:
         return frozenset(self.roles.get(qualname, ()))
+
+    def attr_types(self) -> Dict[str, Dict[str, str]]:
+        """``"module:Class"`` -> {attr: constructed ``"module:Class"``} —
+        the constructor-type layer, shared with the v6 transfer-discipline
+        pass (it resolves ``self.trainer.train_step(...)``-shaped calls
+        through the same typed receivers the role propagation uses)."""
+        return self._attr_types
 
     def known_roles(self) -> Set[str]:
         return {e.role for e in self.entries}

@@ -536,6 +536,34 @@ def install_lock_collector(registry: Registry) -> Callable[[], None]:
     return _collect
 
 
+# -- jitsan compile bridge (v6) --------------------------------------------
+
+
+def install_jit_collector(registry: Registry) -> Callable[[], None]:
+    """Expose jitsan's per-name lowering counts as
+    ``edl_jit_compiles_total{fn=...}`` on ``registry`` — scrape-side,
+    like the locksan bridge: the counting itself rides the jit tracer
+    (common/jitsan.py), this only mirrors the aggregates, so a scrape
+    costs the hot path nothing.  With ``GRAFT_JITSAN`` unset the jitted
+    functions are plain and the family simply stays empty.  A count that
+    climbs after warmup IS the signal: the step is retracing in
+    production (watch_job.py renders the family with per-scrape deltas).
+    Returns the collector (for ``remove_collector`` in tests)."""
+    from elasticdl_tpu.common import jitsan
+
+    def _collect() -> None:
+        for name, rec in jitsan.stats().items():
+            registry.counter(
+                "edl_jit_compiles_total",
+                "XLA lowerings per declared jit site (jitsan; a climb "
+                "after warmup means the step is retracing)",
+                labels={"fn": name},
+            ).set_total(rec["compiles"])
+
+    registry.add_collector(_collect)
+    return _collect
+
+
 # -- fleet-view helpers (jax-free; the master's aggregation math) ----------
 
 

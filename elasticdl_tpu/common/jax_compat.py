@@ -18,6 +18,8 @@ import inspect
 import jax
 from jax import lax
 
+from elasticdl_tpu.common import jitsan
+
 try:  # jax >= 0.6 exports shard_map at top level
     _shard_map = jax.shard_map  # type: ignore[attr-defined]
 except AttributeError:  # pragma: no cover - depends on installed jax
@@ -35,7 +37,29 @@ else:
         return _shard_map(*args, **kwargs)
 
 
-def jit_donating(fun, donate_argnums=(0,)):
+def jit_compiled(fun, name=None, expected_variants=1, **jit_kwargs):
+    """``jax.jit`` through the shim, with a compile-stability declaration.
+
+    ``name`` keys the jitsan registry (graftlint's jit-shim pass requires
+    it at call sites — the gauge label ``edl_jit_compiles_total{fn=}``
+    and the LINT artifact's budget table are only as good as the names);
+    ``expected_variants`` declares how many times THIS returned callable
+    may lower (distinct shapes/dtypes/static args).  With ``GRAFT_JITSAN``
+    unset the declaration costs nothing: the plain jitted function comes
+    back untouched.  Armed (tier-1-wide via tests/conftest.py), every
+    lowering is counted and a lowering past the budget raises
+    ``jitsan.JitSanViolation`` deterministically at the drifting call
+    (common/jitsan.py).
+    """
+    if not jitsan.enabled():
+        return jax.jit(fun, **jit_kwargs)
+    return jitsan.wrap(
+        jax.jit, fun, name=name, expected_variants=expected_variants,
+        jit_kwargs=jit_kwargs,
+    )
+
+
+def jit_donating(fun, donate_argnums=(0,), name=None, expected_variants=1):
     """``jax.jit`` with input-buffer donation — the train-step spelling.
 
     One shim owns the donation kwarg so every donating step (train, scan)
@@ -45,8 +69,18 @@ def jit_donating(fun, donate_argnums=(0,)):
     output state — without it every step holds two full copies of
     params + optimizer state resident (measurable on CPU as peak-RSS
     delta; tools/optshard_bench.py records the A/B).
+
+    ``name=``/``expected_variants=`` declare the jitsan compile budget,
+    exactly as in :func:`jit_compiled` — donation makes stable jit
+    identity MORE load-bearing, not less (a retrace on a donating step
+    re-lowers against already-consumed buffers' layouts).
     """
-    return jax.jit(fun, donate_argnums=donate_argnums)
+    if not jitsan.enabled():
+        return jax.jit(fun, donate_argnums=donate_argnums)
+    return jitsan.wrap(
+        jax.jit, fun, name=name, expected_variants=expected_variants,
+        jit_kwargs={"donate_argnums": donate_argnums},
+    )
 
 
 def enable_cpu_multiprocess_collectives() -> None:

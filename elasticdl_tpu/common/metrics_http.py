@@ -180,6 +180,10 @@ def maybe_start(
             from elasticdl_tpu.common import gauge
 
             gauge.install_lock_collector(registry)
+            # jitsan compile counts (v6) ride the same wiring idiom: the
+            # edl_jit_compiles_total family joins every endpoint so a
+            # production retrace shows up in watch_job, not just tests.
+            gauge.install_jit_collector(registry)
         return server
     except OSError:
         logger.exception(

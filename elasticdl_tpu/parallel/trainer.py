@@ -51,7 +51,7 @@ from elasticdl_tpu.ops.embedding import (
     table_shape,
 )
 
-from elasticdl_tpu.common.jax_compat import jit_donating, shard_map
+from elasticdl_tpu.common.jax_compat import jit_compiled, jit_donating, shard_map
 
 
 class TrainState(struct.PyTreeNode):
@@ -302,6 +302,24 @@ class Trainer:
         self._train_step = None
         self._eval_step = None
         self._predict_step = None
+        # jitsan (v6) compile budgets: how many times each built step may
+        # LOWER per compiled callable.  Per-step shapes are fixed by the
+        # wrap-padding contract, so the per-step budgets are 1 — the
+        # fixed-shape promise of docs/perf.md, now enforced at runtime.
+        # The scan variants lower once per distinct task length T (full
+        # tasks share one T; the job's remainder task adds a second), so
+        # they carry headroom instead of a false alarm.  The serving
+        # tier overrides predict_step to its padded-shape bucket count.
+        # Written only at construction/serving-setup time; the step
+        # builders read it.
+        self.jit_budgets: Dict[str, int] = {
+            "train_step": 1,
+            "train_scan": 4,
+            "eval_step": 1,
+            "eval_scan": 4,
+            "predict_step": 1,
+            "snapshot_state": 1,
+        }
         # Host-tier tables (spec.host_io): rows live in the native C++ store
         # — in-process on this host (single-process meshes), or behind the
         # gRPC PS service tier when the job runs PS pods (config.ps_addresses
@@ -760,6 +778,7 @@ class Trainer:
 
     # hot-path: dispatch-only by design — ONE jitted device-side copy per
     # checkpoint boundary, no transfers or collectives on the caller
+    # jit-boundary: returns device buffers fresh off the compiled copy
     def snapshot_state(self, state: TrainState) -> TrainState:
         """ONE jitted device-side copy of the live state in the CANONICAL
         layout: fresh buffers no later step can donate (copying the live
@@ -788,7 +807,10 @@ class Trainer:
                 )
 
             # graftlint: allow[shared-state] idempotent jit memo: a racing rebuild costs one duplicate compile of the same function, and either reference is valid
-            self._snapshot_fn = jax.jit(snap)
+            self._snapshot_fn = jit_compiled(
+                snap, name="trainer.snapshot_state",
+                expected_variants=self.jit_budgets["snapshot_state"],
+            )
         return self._snapshot_fn(state)
 
     def _batch_spec_for(self, leaf) -> P:
@@ -947,6 +969,7 @@ class Trainer:
             else:
                 self._host_stores[key].push_grad(ids[key], np.asarray(grads))
 
+    # jit-boundary: state/metrics come back undisposed off the jitted step
     def run_train_step(self, state: TrainState, batch: Any):
         """Full training step from a HOST batch: host-tier pull -> shard ->
         jitted step -> sparse cotangent push.  Without host tables this is
@@ -960,6 +983,7 @@ class Trainer:
         self._push_host_grads(ids, host_grads)
         return state, metrics
 
+    # jit-boundary: state/metrics come back undisposed off the jitted step
     def run_train_steps(
         self,
         state: TrainState,
@@ -1036,11 +1060,13 @@ class Trainer:
                 last_good if _state_alive(last_good) else None, e
             ) from e
 
+    # jit-boundary: metrics come back undisposed off the jitted step
     def run_eval_step(self, state: TrainState, batch: Any):
         if self.spec.host_io:
             batch, _ = self._inject_host_rows(batch)
         return self.eval_step(state, self.shard_batch(batch))
 
+    # jit-boundary: outputs come back undisposed off the jitted step
     def run_predict_step(self, state: TrainState, batch: Any):
         if self.spec.host_io:
             batch, _ = self._inject_host_rows(batch)
@@ -1220,10 +1246,12 @@ class Trainer:
             collective=self.collective,
         )
 
+    # jit-boundary: returns device buffers fresh off the compiled step
     def train_step(self, state: TrainState, batch: Any):
         self._train_step = self._structured(
             self._train_steps, build_train_step, batch,
             host_keys=tuple(sorted(self.spec.host_io)),
+            variant_budget=self.jit_budgets["train_step"],
             **self._train_build_kwargs(),
         )
         return self._train_step(state, batch, self._active_device())
@@ -1270,6 +1298,7 @@ class Trainer:
             cache[key] = fn
         return fn
 
+    # jit-boundary: returns device buffers fresh off the compiled scan
     def train_scan(self, state: TrainState, stacked: Any):
         """All T steps of a task in one jitted lax.scan (one dispatch, one
         compiled program — see build_train_step(scan_steps=True)).
@@ -1277,28 +1306,35 @@ class Trainer:
         (state, metrics dict of [T]-stacked scalars)."""
         self._train_step = self._scanned(
             self._train_steps, build_train_step, stacked, host_keys=(),
+            variant_budget=self.jit_budgets["train_scan"],
             **self._train_build_kwargs(),
         )
         return self._train_step(state, stacked, self._active_device())
 
+    # jit-boundary: returns device metrics fresh off the compiled step
     def eval_step(self, state: TrainState, batch: Any) -> Dict[str, jax.Array]:
         self._eval_step = self._structured(
-            self._eval_steps, build_eval_step, batch
+            self._eval_steps, build_eval_step, batch,
+            variant_budget=self.jit_budgets["eval_step"],
         )
         return self._eval_step(state, batch)
 
+    # jit-boundary: returns device metrics fresh off the compiled scan
     def eval_scan(self, state: TrainState, stacked: Any):
         """All T eval steps of a task in one jitted lax.scan (see
         build_eval_step(scan_steps=True)).  Returns a metrics dict of
         [T]-stacked leaves; the caller weights per-chunk as usual."""
         self._eval_step = self._scanned(
-            self._eval_steps, build_eval_step, stacked
+            self._eval_steps, build_eval_step, stacked,
+            variant_budget=self.jit_budgets["eval_scan"],
         )
         return self._eval_step(state, stacked)
 
+    # jit-boundary: returns device outputs fresh off the compiled step
     def predict_step(self, state: TrainState, batch: Any):
         self._predict_step = self._structured(
-            self._predict_steps, build_predict_step, batch
+            self._predict_steps, build_predict_step, batch,
+            variant_budget=self.jit_budgets["predict_step"],
         )
         return self._predict_step(state, batch)
 
@@ -1316,6 +1352,7 @@ def build_train_step(
     opt_shard_axis: Optional[str] = None,
     donate: bool = True,
     collective: Any = None,
+    variant_budget: int = 1,
 ) -> Callable:
     """The jitted train step ``(state, batch, active) -> ...``.  With
     ``host_keys`` (host-tier tables), the step ALSO differentiates with
@@ -1538,7 +1575,15 @@ def build_train_step(
             out_specs=(state_specs, P()),
             check_vma=False,
         )
-        return jit_donating(mapped) if donate else jax.jit(mapped)
+        if donate:
+            return jit_donating(
+                mapped, name="trainer.train_scan",
+                expected_variants=variant_budget,
+            )
+        return jit_compiled(
+            mapped, name="trainer.train_scan",
+            expected_variants=variant_budget,
+        )
 
     out_specs: Tuple = (state_specs, P())
     if host_keys:
@@ -1557,7 +1602,13 @@ def build_train_step(
         out_specs=out_specs,
         check_vma=False,
     )
-    return jit_donating(mapped) if donate else jax.jit(mapped)
+    if donate:
+        return jit_donating(
+            mapped, name="trainer.train_step", expected_variants=variant_budget
+        )
+    return jit_compiled(
+        mapped, name="trainer.train_step", expected_variants=variant_budget
+    )
 
 
 def build_predict_step(
@@ -1567,6 +1618,7 @@ def build_predict_step(
     state_specs: TrainState,
     batch_specs: Any = None,
     batch_axes: Optional[Tuple[str, ...]] = None,
+    variant_budget: int = 1,
 ) -> Callable:
     """Per-example model outputs, batch-sharded in and out (the reference's
     predict mode, SURVEY.md §2 #1 'predict').  Models with a ``predict``
@@ -1597,7 +1649,9 @@ def build_predict_step(
         out_specs=out_spec,
         check_vma=False,
     )
-    return jax.jit(mapped)
+    return jit_compiled(
+        mapped, name="trainer.predict_step", expected_variants=variant_budget
+    )
 
 
 def build_eval_step(
@@ -1608,6 +1662,7 @@ def build_eval_step(
     batch_specs: Any = None,
     batch_axes: Optional[Tuple[str, ...]] = None,
     scan_steps: bool = False,
+    variant_budget: int = 1,
 ) -> Callable:
     axis = ctx.axis_name
     assert axis is not None
@@ -1664,7 +1719,9 @@ def build_eval_step(
             out_specs=P(),
             check_vma=False,
         )
-        return jax.jit(mapped)
+        return jit_compiled(
+            mapped, name="trainer.eval_scan", expected_variants=variant_budget
+        )
 
     mapped = shard_map(
         local_eval,
@@ -1673,4 +1730,6 @@ def build_eval_step(
         out_specs=P(),
         check_vma=False,
     )
-    return jax.jit(mapped)
+    return jit_compiled(
+        mapped, name="trainer.eval_step", expected_variants=variant_budget
+    )

@@ -133,6 +133,13 @@ class ServingServer:
         # Mesh-sharded-table models still restore fine: the padded table
         # shapes are mesh-size-invariant (trainer.pad_embedding_tables).
         self.trainer = Trainer(spec, config, create_mesh([jax.devices()[0]]))
+        # jitsan (v6): the padded-shape buckets this replica serves — ONE
+        # today (every flush zero-pads to max_batch); the batch-size-
+        # bucketed compiles of ROADMAP item 3 extend this tuple, and the
+        # declared budget follows it, so an accidental extra compile (a
+        # shape leaking past the batcher's padding) still fails loud.
+        self._shape_buckets = (max_batch,)
+        self.trainer.jit_budgets["predict_step"] = len(self._shape_buckets)
         # Hot-id cache in front of every host-tier store (no-op for models
         # without host tables).
         self._caches: Dict[str, HotIdEmbeddingCache] = {}

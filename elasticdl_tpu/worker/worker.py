@@ -26,7 +26,7 @@ import numpy as np
 
 from elasticdl_tpu import chaos
 from elasticdl_tpu.common import gauge as gaugelib
-from elasticdl_tpu.common import locksan, trace
+from elasticdl_tpu.common import jitsan, locksan, trace
 from elasticdl_tpu.common.checkpoint import CheckpointManager
 from elasticdl_tpu.common.config import JobConfig
 from elasticdl_tpu.common.log_utils import get_logger
@@ -1478,7 +1478,12 @@ class Worker:
                         stacked = self._stack_full_minibatches(
                             records, mb, n_full
                         )
-                with self.phases.phase("dispatch"):
+                # jitsan (v6): the optional transfer guard makes any
+                # IMPLICIT device->host materialization inside the
+                # dispatch window a loud failure (explicit device_put /
+                # device_get spellings stay legal) — the runtime half of
+                # graftlint's transfer-discipline rule.
+                with self.phases.phase("dispatch"), jitsan.transfer_guard():
                     self.state, scan_metrics = self.trainer.train_scan(
                         self.state, self.trainer.shard_stacked_batch(stacked)
                     )
@@ -1514,7 +1519,14 @@ class Worker:
                 # per-step feed runs inside the same consumer loop, so this
                 # path's decode time lands under "dispatch" — honest for a
                 # mode whose decode and dispatch genuinely interleave.
-                with self.phases.phase("dispatch"):
+                # when=: host-tier models materialize sparse cotangents
+                # (np.asarray in _push_host_grads) INSIDE this window by
+                # design — the documented sync point — so the guard arms
+                # only for the dense paths where any implicit transfer is
+                # a genuine leak.
+                with self.phases.phase("dispatch"), jitsan.transfer_guard(
+                    when=not self.spec.host_io
+                ):
                     self.state, metrics_list = self.trainer.run_train_steps(
                         self.state,
                         prefetch(
@@ -2103,6 +2115,7 @@ class Worker:
             name=f"prefetch:{task.task_id}",
         ):
             out = self.trainer.run_predict_step(self.state, batch)
+            # graftlint: allow[transfer-discipline] the materialized outputs ARE the prediction task's product; the per-batch fetch is the work
             outs.append(np.asarray(out)[:true_count])
         if self.config.prediction_outputs:
             os.makedirs(self.config.prediction_outputs, exist_ok=True)

@@ -34,6 +34,17 @@ os.environ.setdefault("GRAFT_LOCKSAN", "1")
 # imported (the decorator reads it at class-creation time).
 os.environ.setdefault("GRAFT_RACESAN", "1")
 
+# Runtime jit-compile sanitizer (common/jitsan.py) ON for the whole
+# tier-1 suite — the dynamic twin of graftlint's v6 jit-discipline
+# passes: every jax_compat.jit_compiled/jit_donating callable counts its
+# XLA lowerings and raises deterministically past its declared
+# expected_variants budget, so the entire suite PROVES the train step
+# compiles exactly once after warmup (mask flips and elastic reforms add
+# zero recompiles).  setdefault so GRAFT_JITSAN=0 forces it off; the
+# stricter GRAFT_JITSAN_TRANSFER_GUARD stays opt-in (compilation itself
+# may move constants).
+os.environ.setdefault("GRAFT_JITSAN", "1")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

@@ -230,19 +230,27 @@ def test_excluded_rank_with_ragged_mask(devices):
 
 
 def test_mask_flip_never_recompiles(devices):
+    # Asserted via jitsan's lowering counters (common/jitsan.py, armed
+    # suite-wide by conftest) instead of the r15 private jit cache probe:
+    # the counter is the same signal production gauges/watch_job read, so
+    # the test and the ops story can no longer drift.
+    from elasticdl_tpu.common import jitsan
+
+    if not jitsan.enabled():
+        pytest.skip("jitsan disabled (GRAFT_JITSAN != 1)")
     spec = _mnist_spec()
     t = _trainer(spec, 4)
     state = t.init_state(jax.random.key(0))
     batch = _mnist_batch(64)
-    state, _ = t.train_step(state, t.shard_batch(batch))
+    state, _ = t.train_step(state, t.shard_batch(batch))  # warmup compile
     fn = t._train_step
+    warm = jitsan.compiles("trainer.train_step")
     for mask in ([1, 1, 1, 0], [0, 1, 1, 1], None, [1, 0, 1, 1]):
         t.set_active_contributors(mask)
         state, _ = t.train_step(state, t.shard_batch(batch))
     assert t._train_step is fn  # same structural build
-    cache_size = getattr(fn, "_cache_size", None)
-    if cache_size is not None:  # jax version-dependent introspection
-        assert cache_size() == 1  # ONE compiled program across all masks
+    # ZERO lowerings across every mask flip: the mask is a traced input.
+    assert jitsan.compiles("trainer.train_step") == warm
 
 
 def test_scan_variant_carries_mask(devices):

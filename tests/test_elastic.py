@@ -193,7 +193,15 @@ def test_sharded_moments_survive_2_4_2_reform(devices):
     2->4->2 resize must REDISTRIBUTE the existing Adam moments across the
     new shard layout — bit-exactly, since the canonical bridge is pure
     data movement — never re-initialize them (a silent convergence
-    regression on every join/leave)."""
+    regression on every join/leave).
+
+    Compile accounting rides jitsan's lowering counters (v6, armed
+    suite-wide by conftest): each topology's step lowers exactly ONCE —
+    on its first dispatch after the reform — and repeat steps at a
+    topology add ZERO recompiles, so a reform costs one deliberate
+    re-lower and nothing else."""
+    from elasticdl_tpu.common import jitsan
+
     spec = load_model_spec("elasticdl_tpu.models", "deepfm.model_spec", **DEEPFM_TINY)
     config = JobConfig(
         distribution_strategy=DistributionStrategy.PARAMETER_SERVER,
@@ -202,10 +210,17 @@ def test_sharded_moments_survive_2_4_2_reform(devices):
     batch = spec.example_batch(32)
     batch["cat"] = np.arange(32 * 26, dtype=np.int32).reshape(32, 26) % 1000
 
+    def train_compiles():
+        return jitsan.compiles("trainer.train_step")
+
     t = Trainer(spec, config, create_mesh(devices, num_devices=2))
     state = t.init_state(jax.random.key(0))
+    c0 = train_compiles()
     for _ in range(2):
         state, _ = t.train_step(state, t.shard_batch(batch))
+    if jitsan.enabled():
+        # One lowering for the 2-way build; the second step adds zero.
+        assert train_compiles() == c0 + 1
     before = t.host_state(state)  # canonical: param-shaped moments
 
     # 2 -> 4: the worker reform path (set_mesh + canonical re-placement).
@@ -216,8 +231,14 @@ def test_sharded_moments_survive_2_4_2_reform(devices):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     state, m4 = t.train_step(state, t.shard_batch(batch))
     assert np.isfinite(float(m4["loss"]))
+    state, m4b = t.train_step(state, t.shard_batch(batch))
+    assert np.isfinite(float(m4b["loss"]))
+    if jitsan.enabled():
+        # The reform re-lowered exactly once for the 4-way topology; the
+        # repeat step at 4-way added zero.
+        assert train_compiles() == c0 + 2
 
-    # 4 -> 2, carrying the step trained at 4-way.
+    # 4 -> 2, carrying the steps trained at 4-way.
     after4 = t.host_state(state)
     t.set_mesh(create_mesh(devices, num_devices=2))
     state = t.shard_state(t.host_state(state))
@@ -225,7 +246,9 @@ def test_sharded_moments_survive_2_4_2_reform(devices):
     for a, b in zip(jax.tree.leaves(after4), jax.tree.leaves(back)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     state, m2 = t.train_step(state, t.shard_batch(batch))
-    assert int(state.step) == 4 and np.isfinite(float(m2["loss"]))
+    assert int(state.step) == 5 and np.isfinite(float(m2["loss"]))
+    if jitsan.enabled():
+        assert train_compiles() == c0 + 3  # one re-lower back at 2-way
 
 
 def test_sharded_checkpoint_restores_across_world_sizes(tmp_path, devices):

@@ -1877,3 +1877,389 @@ def test_shared_state_same_role_unlocked_read_not_flagged():
             w.run()
     """
     assert _lint(src, [SharedStatePass()]) == []
+
+
+# ---- jit-shim (v6) ----
+
+JIT_SHIM_SEEDED = """
+    import jax
+    from jax import jit
+    from elasticdl_tpu.common.jax_compat import jit_compiled
+
+    def build(fn):
+        return jax.jit(fn)
+
+    def build_shimmed(fn):
+        return jit_compiled(fn)
+"""
+
+JIT_SHIM_CLEAN = """
+    from elasticdl_tpu.common.jax_compat import jit_compiled, jit_donating
+
+    def build(fn):
+        return jit_compiled(fn, name="mod.step", expected_variants=1)
+
+    def build_donating(fn):
+        return jit_donating(fn, name="mod.train", expected_variants=2)
+"""
+
+
+def test_jit_shim_seeded_and_clean():
+    from elasticdl_tpu.analysis.jit_discipline import JitShimPass
+
+    findings = _lint(JIT_SHIM_SEEDED, [JitShimPass()])
+    msgs = [f.message for f in findings]
+    assert _rules(findings) == {"jit-shim"}
+    assert len(findings) == 3  # raw attr, raw import alias, missing name=
+    assert any("from jax import jit" in m for m in msgs)
+    assert any("raw jax.jit" in m for m in msgs)
+    assert any("declares no name=" in m for m in msgs)
+    assert _lint(JIT_SHIM_CLEAN, [JitShimPass()]) == []
+
+
+def test_jit_shim_exempts_the_shim_module():
+    from elasticdl_tpu.analysis.jit_discipline import JitShimPass
+    import textwrap
+
+    src = SourceFile(
+        "elasticdl_tpu/common/jax_compat.py",
+        textwrap.dedent("""
+            import jax
+
+            def jit_compiled(fun, name=None, expected_variants=1):
+                return jax.jit(fun)
+        """),
+    )
+    assert run_passes([src], [JitShimPass()]) == []
+
+
+# ---- jit-stability (v6) ----
+
+JIT_STABILITY_SEEDED = """
+    from elasticdl_tpu.common.jax_compat import jit_compiled
+
+    class Stepper:
+        def step(self, x):
+            out = jit_compiled(self._fn, name="s.direct")(x)
+            return out
+
+        def step2(self, x):
+            f = jit_compiled(self._fn, name="s.local")
+            return f(x)
+"""
+
+JIT_STABILITY_CLEAN = """
+    import jax
+    from elasticdl_tpu.common.jax_compat import jit_compiled
+
+    _module_step = jit_compiled(lambda x: x, name="s.mod")
+    _module_step(1)
+
+    class Stepper:
+        def step(self, x):
+            if self._fn is None:
+                self._fn = jit_compiled(self._impl, name="s.memo")
+            return self._fn(x)
+
+        def build(self):
+            return jit_compiled(self._impl, name="s.builder")
+
+        def bucketed(self, shapes):
+            for n in shapes:
+                self._cache[n] = jit_compiled(self._impl, name="s.bucket")
+"""
+
+
+def test_jit_stability_seeded_and_clean():
+    from elasticdl_tpu.analysis.jit_discipline import JitStabilityPass
+
+    findings = _lint(JIT_STABILITY_SEEDED, [JitStabilityPass()])
+    assert _rules(findings) == {"jit-stability"}
+    assert len(findings) == 2  # direct-invoke + local-bound-and-called
+    assert any("created and invoked in one expression" in f.message
+               for f in findings)
+    assert any("bound to local 'f'" in f.message for f in findings)
+    # Module-level bind, self-attr memo, builder return, cache subscript:
+    # every legal ownership shape is silent.
+    assert _lint(JIT_STABILITY_CLEAN, [JitStabilityPass()]) == []
+
+
+def test_jit_stability_waivable_with_reason():
+    from elasticdl_tpu.analysis.jit_discipline import JitStabilityPass
+
+    src = """
+        from elasticdl_tpu.common.jax_compat import jit_compiled
+
+        def probe(fn, x):
+            # graftlint: allow[jit-stability] one-shot probe: runs once per process
+            f = jit_compiled(fn, name="p.probe")
+            return f(x)
+    """
+    assert _lint(src, [JitStabilityPass()]) == []
+
+
+# ---- transfer-discipline (v6) ----
+
+TRANSFER_SEEDED = """
+    import numpy as np
+
+    class Trainer:
+        # jit-boundary: returns device buffers off the compiled step
+        def step(self, state, batch):
+            return state
+
+    class Worker:
+        def __init__(self):
+            self.trainer = Trainer()
+
+        # hot-path
+        def loop(self, state, batch):
+            out = self.trainer.step(state, batch)
+            return float(out)
+"""
+
+TRANSFER_CLEAN = """
+    import numpy as np
+
+    class Trainer:
+        # jit-boundary
+        def step(self, state, batch):
+            return state
+
+    class Worker:
+        def __init__(self):
+            self.trainer = Trainer()
+
+        # hot-path
+        def loop(self, state, batch):
+            out = self.trainer.step(state, batch)
+            with self.phases.phase("step_wait"):
+                host = float(out)  # accounted: the deliberate drain
+            return host
+
+        def offline_report(self, state, batch):
+            out = self.trainer.step(state, batch)
+            return float(out)  # not hot-path: scoping is the point
+"""
+
+
+def test_transfer_discipline_seeded_and_clean():
+    from elasticdl_tpu.analysis.jit_discipline import TransferDisciplinePass
+
+    findings = _lint(TRANSFER_SEEDED, [TransferDisciplinePass()])
+    assert _rules(findings) == {"transfer-discipline"}
+    assert len(findings) == 1
+    assert "float() over a jit-boundary value" in findings[0].message
+    assert _lint(TRANSFER_CLEAN, [TransferDisciplinePass()]) == []
+
+
+def test_transfer_discipline_propagates_through_helpers():
+    # The wrapped transfer the per-function view cannot see: a hot-path
+    # function reaching np.asarray-of-step-output through a helper — the
+    # blocking-propagation shape, with the witness chain in the message.
+    from elasticdl_tpu.analysis.jit_discipline import TransferDisciplinePass
+
+    src = """
+        import numpy as np
+
+        class Worker:
+            # jit-boundary
+            def step(self, state):
+                return state
+
+            def _settle(self, state):
+                out = self.step(state)
+                return np.asarray(out)
+
+            # hot-path
+            def loop(self, state):
+                return self._settle(state)
+    """
+    findings = _lint(src, [TransferDisciplinePass()])
+    assert len(findings) == 1
+    f = findings[0]
+    assert "callee chain materializes" in f.message
+    assert "np.asarray" in f.message  # witness down to the primitive
+
+
+def test_transfer_discipline_infers_boundary_through_returns():
+    # run_step returns self.step(...): boundary-ness propagates through
+    # the return fixpoint, so only the innermost function needs the
+    # annotation (the Trainer.run_* adoption shape).
+    from elasticdl_tpu.analysis.jit_discipline import TransferDisciplinePass
+
+    src = """
+        class Worker:
+            # jit-boundary
+            def step(self, state):
+                return state
+
+            def run_step(self, state):
+                return self.step(state)
+
+            # hot-path
+            def loop(self, state):
+                out = self.run_step(state)
+                return out.item()
+    """
+    findings = _lint(src, [TransferDisciplinePass()])
+    assert len(findings) == 1
+    assert ".item() materializes" in findings[0].message
+
+
+def test_transfer_discipline_jit_bound_local_flow():
+    # out = step(x) where step came from jit_compiled: jit-flow without
+    # any annotation — the syntactic half of the boundary model.
+    from elasticdl_tpu.analysis.jit_discipline import TransferDisciplinePass
+
+    src = """
+        from elasticdl_tpu.common.jax_compat import jit_compiled
+
+        # hot-path
+        def loop(fn, x):
+            step = jit_compiled(fn, name="m.step")
+            out = step(x)
+            return out.tolist()
+    """
+    findings = _lint(src, [TransferDisciplinePass()])
+    assert len(findings) == 1
+    assert ".tolist() materializes" in findings[0].message
+
+
+def test_transfer_discipline_waived_primitive_does_not_propagate():
+    from elasticdl_tpu.analysis.jit_discipline import TransferDisciplinePass
+
+    src = """
+        import numpy as np
+
+        class Worker:
+            # jit-boundary
+            def step(self, state):
+                return state
+
+            def _settle(self, state):
+                out = self.step(state)
+                # graftlint: allow[transfer-discipline] the settle IS the product
+                return np.asarray(out)
+
+            # hot-path
+            def loop(self, state):
+                return self._settle(state)
+    """
+    assert _lint(src, [TransferDisciplinePass()]) == []
+
+
+def test_transfer_discipline_except_handler_exempt():
+    from elasticdl_tpu.analysis.jit_discipline import TransferDisciplinePass
+
+    src = """
+        class Worker:
+            # jit-boundary
+            def step(self, state):
+                return state
+
+            # hot-path
+            def loop(self, state):
+                out = self.step(state)
+                try:
+                    return out
+                except Exception:
+                    return float(out)  # error path: off the hot path
+    """
+    assert _lint(src, [TransferDisciplinePass()]) == []
+
+
+# ---- thread-map: functools.partial targets (v6 satellite) ----
+
+def test_thread_map_resolves_partial_targets():
+    from elasticdl_tpu.analysis.thread_map import shared_thread_map
+
+    src = SourceFile("mod.py", textwrap.dedent("""
+        import functools
+        import threading
+        from functools import partial
+
+        class W:
+            def start(self, pool):
+                t = threading.Thread(
+                    target=functools.partial(self._beat, 1), daemon=True
+                )
+                t.start()
+                pool.submit(partial(self._load, "k"))
+
+            def _beat(self, n):
+                pass
+
+            def _load(self, key):
+                pass
+    """))
+    tmap = shared_thread_map([src])
+    roles = tmap.dump()["roles"]
+    assert "mod:W._beat" in roles.get("thread:_beat", [])
+    assert "mod:W._load" in roles.get("pool:_load", [])
+
+
+def test_shared_state_sees_through_partial_spawn():
+    # The muted-check regression the satellite fixes: a racy write inside
+    # a partial-wrapped thread target must now be a shared-state finding.
+    from elasticdl_tpu.analysis.shared_state import SharedStatePass
+
+    src = """
+        import functools
+        import threading
+
+        class W:
+            def __init__(self):
+                self._hits = 0
+
+            def start(self):
+                threading.Thread(
+                    target=functools.partial(self._bump, 1), daemon=True
+                ).start()
+
+            def _bump(self, n):
+                self._hits += n
+
+            def report(self):
+                print(self._hits)
+
+        def main():
+            w = W()
+            w.start()
+            w.report()
+    """
+    findings = _lint(src, [SharedStatePass()])
+    assert len(findings) == 1
+    assert "_hits" in findings[0].message
+
+
+# ---- declared_sites (the artifact's static budget table) ----
+
+def test_declared_sites_harvest():
+    from elasticdl_tpu.analysis.jit_discipline import declared_sites
+
+    src = SourceFile("mod.py", textwrap.dedent("""
+        from elasticdl_tpu.common.jax_compat import jit_compiled, jit_donating
+
+        def a(fn):
+            return jit_compiled(fn, name="m.step", expected_variants=2)
+
+        def b(fn):
+            return jit_donating(fn, name="m.step", expected_variants=1)
+
+        def c(fn, n):
+            return jit_compiled(fn, name="m.buckets", expected_variants=n)
+
+        def d(fn, variant_budget=3):
+            return jit_compiled(
+                fn, name="m.param", expected_variants=variant_budget)
+    """))
+    sites = declared_sites([src])
+    assert sites["m.step"]["budget"] == 2  # max across sites
+    assert len(sites["m.step"]["sites"]) == 2
+    assert not sites["m.step"]["dynamic"]
+    assert sites["m.buckets"]["budget"] is None  # unresolvable expression
+    # expected_variants=<param>: resolved through the parameter default
+    # (the trainer-builder shape), marked dynamic since callers may
+    # override upward.
+    assert sites["m.param"]["budget"] == 3 and sites["m.param"]["dynamic"]

@@ -60,8 +60,14 @@ def _link_probe(log=lambda msg: None) -> dict:
     import jax
     import jax.numpy as jnp
 
+    from elasticdl_tpu.common.jax_compat import jit_compiled
+
     d = jax.devices()[0]
-    f = jax.jit(lambda a: jnp.sum(a, dtype=jnp.int32))
+    # graftlint: allow[jit-stability] one-shot link probe: the process runs this exactly once, and the probe's 2 lowerings (8B + MB buffers) are the measurement
+    f = jit_compiled(
+        lambda a: jnp.sum(a, dtype=jnp.int32),
+        name="bench_e2e.link_probe", expected_variants=2,
+    )
     tiny = np.zeros(8, np.uint8)
     int(f(jax.device_put(tiny, d)))  # warm the compile
     rtts = []

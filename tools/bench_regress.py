@@ -148,8 +148,30 @@ EXTRACTORS = {
     # graftlint (r16): the trajectory gate covers LINT DEBT too — the
     # repo-wide findings count must only ever go down (it is 0 at every
     # shipped rev; any increase is a regression against a zero baseline).
+    # v6 adds the jitsan compile contract: per declared jit site, the
+    # measured lowerings past the declared budget (compiles minus
+    # instances*budget, floored at 0).  The series is 0 at every healthy
+    # rev, so any climb off the zero baseline — a production retrace the
+    # declared variant budget does not cover — gates outright under the
+    # zero-baseline LOWER rule below.
     "lint_findings": lambda d: {
         "findings": (d.get("findings"), LOWER),
+        **{
+            f"jit_over_budget[{fn}]": (
+                max(
+                    0.0,
+                    float(rec.get("compiles", 0))
+                    - float(rec.get("instances", 1))
+                    * float(rec.get("budget", 1)),
+                ),
+                LOWER,
+            )
+            for fn, rec in sorted(
+                (((d.get("jitsan") or {}).get("runtime")) or {}).items()
+            )
+            # underscore keys are dump metadata (_meta), not jit sites
+            if isinstance(rec, dict) and not fn.startswith("_")
+        },
     },
 }
 

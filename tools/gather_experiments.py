@@ -159,6 +159,8 @@ def main():
     args = ap.parse_args()
     _init_jax()
     enable_compile_cache()
+    from elasticdl_tpu.common.jax_compat import jit_compiled
+
     print(f"devices: {jax.devices()}", file=sys.stderr)
 
     key = jax.random.key(0)
@@ -175,7 +177,10 @@ def main():
             out = fn(t, ids)
             return jnp.sum(out * out)
 
-        step = jax.jit(jax.grad(loss))
+        # graftlint: allow[jit-stability] bench main runs once per process; one fresh compile per measured lookup variant IS the experiment
+        step = jit_compiled(
+            jax.grad(loss), name=f"gather_experiments.{name}"
+        )
         try:
             t0 = time.perf_counter()
             g = step(table)

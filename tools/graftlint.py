@@ -40,7 +40,12 @@ if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
 DEFAULT_PATHS = ("elasticdl_tpu", "tools")
-ARTIFACT_NAME = "LINT_r16.json"
+ARTIFACT_NAME = "LINT_r17.json"
+
+#: jitsan runtime stats (common/jitsan.py dump, GRAFT_JITSAN_DUMP) merged
+#: into the artifact when present: the static tool stays jax-free, so the
+#: measured compile counts come from a jitsan-armed run's dump file.
+JITSAN_STATS_DEFAULT = os.path.join("artifacts", "jitsan_stats.json")
 
 
 def _changed_files(repo: str) -> Optional[List[str]]:
@@ -245,12 +250,53 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
 
     if args.artifact is not None:
+        from elasticdl_tpu.analysis.jit_discipline import declared_sites
         from tools.artifact import code_rev, write_artifact
 
         by_rule = Counter(f.rule for f in findings)
         waivers_by_rule = Counter(w["rule"] for w in waivers)
         cg = _callgraph_dump(sources)
         tm = _threadmap_dump(sources)
+        # v6 jitsan section: the statically declared name/budget table,
+        # plus the runtime lowering counts when a jitsan-armed run left a
+        # dump (env JITSAN_STATS overrides the default path).  The
+        # bench_regress trajectory gate reads the runtime half: any
+        # compile count past its declared budget gates outright.
+        stats_path = os.environ.get(
+            "JITSAN_STATS", os.path.join(_REPO_ROOT, JITSAN_STATS_DEFAULT)
+        )
+        jitsan_runtime = None
+        jitsan_meta: dict = {}
+        if os.path.exists(stats_path):
+            try:
+                with open(stats_path, encoding="utf-8") as f:
+                    loaded = json.load(f)
+                if isinstance(loaded, dict):
+                    meta = loaded.pop("_meta", None)
+                    jitsan_runtime = loaded
+                    if isinstance(meta, dict):
+                        jitsan_meta = dict(meta)
+            except (OSError, ValueError):
+                pass  # a torn dump must not fail the lint artifact
+        if jitsan_runtime is not None:
+            # Staleness flag: a dump written before HEAD's commit time
+            # measured DIFFERENT code — stamp the mismatch rather than
+            # silently certifying old counts as this revision's (the
+            # consumer decides; the honest default is to re-run the
+            # armed suite with GRAFT_JITSAN_DUMP and re-stamp).
+            dumped_s = jitsan_meta.get("utc_s") or os.path.getmtime(stats_path)
+            try:
+                r = subprocess.run(
+                    ["git", "log", "-1", "--format=%ct"],
+                    cwd=_REPO_ROOT, capture_output=True, text=True,
+                    timeout=10,
+                )
+                head_s = int(r.stdout.strip()) if r.returncode == 0 else None
+            except Exception:
+                head_s = None
+            jitsan_meta["stale_vs_head"] = (
+                bool(head_s is not None and dumped_s < head_s)
+            )
         write_artifact(
             {
                 # The trajectory gate (tools/bench_regress.py) indexes
@@ -280,6 +326,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                     ],
                 },
                 "hot_path_functions": len(cg["hot_path_functions"]),
+                "jitsan": {
+                    "declared": declared_sites(sources),
+                    "runtime": jitsan_runtime,
+                    "runtime_meta": jitsan_meta,
+                    "stats_file": (
+                        os.path.relpath(stats_path, _REPO_ROOT)
+                        if jitsan_runtime is not None else None
+                    ),
+                },
                 "thread_map": {
                     "roles": len(tm["roles"]),
                     "entries": len(tm["entries"]),

@@ -3,6 +3,12 @@
 # dependents — project-wide passes judge whole-graph properties) before
 # every commit.  Pure stdlib, no jax import: costs milliseconds.
 #
+# The v6 passes ride --changed like the rest: jit-shim and jit-stability
+# are per-file (scoped to the changed set), and transfer-discipline is a
+# project pass over the v2/v5 call graph, so its findings follow the SAME
+# dependent-module scoping as import-hygiene — edit a '# jit-boundary'
+# helper and every hot-path module that calls it re-lints.
+#
 # Install (from the repo root):
 #     ln -sf ../../tools/precommit.sh .git/hooks/pre-commit
 # or, to keep an existing hook, call this script from it.

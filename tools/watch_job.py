@@ -216,6 +216,40 @@ def render_locks(families: Dict[str, dict], top: int = 3) -> Optional[str]:
     return "locks: " + " ".join(parts)
 
 
+def render_compiles(
+    families: Dict[str, dict],
+    prev: Optional[Dict[str, dict]] = None,
+    top: int = 4,
+) -> Optional[str]:
+    """One summary line for the jitsan compile family (v6) — total XLA
+    lowerings plus the ``top`` jit sites by count — or None when the
+    endpoint serves none (jitsan off, or an old build).  In polling mode
+    a count that grew since the previous scrape is marked ``+N RETRACE``:
+    after warmup the steady state adds zero, so any live delta is the
+    silent-throughput-halving retrace this family exists to surface."""
+    fam = families.get("edl_jit_compiles_total")
+    if not fam or not fam["samples"]:
+        return None
+    prev_by_fn: Dict[str, float] = {}
+    if prev:
+        for s in (prev.get("edl_jit_compiles_total") or {}).get(
+            "samples", []
+        ):
+            prev_by_fn[s["labels"].get("fn", "?")] = s["value"]
+    parts = [f"total={sum(s['value'] for s in fam['samples']):.0f}"]
+    ranked = sorted(
+        fam["samples"], key=lambda s: -s["value"]
+    )
+    for s in ranked[:top]:
+        fn = s["labels"].get("fn", "?")
+        cell = f"{fn}={s['value']:.0f}"
+        before = prev_by_fn.get(fn)
+        if before is not None and s["value"] > before:
+            cell += f" (+{s['value'] - before:.0f} RETRACE)"
+        parts.append(cell)
+    return "compiles: " + " ".join(parts)
+
+
 def render_table(families: Dict[str, dict],
                  prefixes: Optional[List[str]] = None) -> str:
     """One aligned line per series; histograms summarize to
@@ -307,6 +341,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             locks = render_locks(families)
             if locks:
                 print(locks)
+            compiles = render_compiles(families, state["prev"])
+            if compiles:
+                print(compiles)
             print(render_table(families))
         state["prev"], state["t"] = families, now
 
