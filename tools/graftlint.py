@@ -279,23 +279,28 @@ def main(argv: Optional[List[str]] = None) -> int:
             except (OSError, ValueError):
                 pass  # a torn dump must not fail the lint artifact
         if jitsan_runtime is not None:
-            # Staleness flag: a dump written before HEAD's commit time
-            # measured DIFFERENT code — stamp the mismatch rather than
+            # Staleness flag: a dump written before the last CODE commit
+            # measured different code — stamp the mismatch rather than
             # silently certifying old counts as this revision's (the
             # consumer decides; the honest default is to re-run the
-            # armed suite with GRAFT_JITSAN_DUMP and re-stamp).
+            # armed suite with GRAFT_JITSAN_DUMP and re-stamp).  The
+            # reference excludes artifacts/-only commits: the stamp
+            # workflow (commit code, refresh dump, commit artifacts)
+            # must not mark its own dump stale — committing artifacts
+            # changes no measured code.
             dumped_s = jitsan_meta.get("utc_s") or os.path.getmtime(stats_path)
             try:
                 r = subprocess.run(
-                    ["git", "log", "-1", "--format=%ct"],
+                    ["git", "log", "-1", "--format=%ct", "--",
+                     ".", ":(exclude)artifacts"],
                     cwd=_REPO_ROOT, capture_output=True, text=True,
                     timeout=10,
                 )
-                head_s = int(r.stdout.strip()) if r.returncode == 0 else None
+                code_s = int(r.stdout.strip()) if r.returncode == 0 else None
             except Exception:
-                head_s = None
-            jitsan_meta["stale_vs_head"] = (
-                bool(head_s is not None and dumped_s < head_s)
+                code_s = None
+            jitsan_meta["stale_vs_code"] = (
+                bool(code_s is not None and dumped_s < code_s)
             )
         write_artifact(
             {
