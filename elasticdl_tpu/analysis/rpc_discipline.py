@@ -18,6 +18,15 @@ must satisfy one of:
 
 ``subprocess.call`` and ``super().call`` (proxy subclass delegating to the
 boundary-owning base) are out of scope by construction.
+
+r18 adds the READINESS half: a bare ``grpc.channel_ready_future`` wait is
+a reconnect loop written by hand — one hard timeout, no retry accounting,
+no jitter (a thundering herd of relaunched workers re-dialing a
+restarting master all at once).  The primitive is legal only inside
+``common/rpc.py``, whose ``wait_channel_ready`` wraps it in the shared
+backoff helper (short probes, jittered, ``edl_rpc_retry_total``
+accounted); every other module routes through that helper or a
+``wait_ready`` method that delegates to it.
 """
 
 from __future__ import annotations
@@ -28,17 +37,25 @@ from typing import Iterable, List
 from elasticdl_tpu.analysis.core import Finding, LintPass, SourceFile, attr_chain
 
 #: Functions that own retry + deadline for the calls inside them.
+#: ``call_with_backoff`` is the r18 shared helper every other wrapper now
+#: delegates to — a lambda passed to it runs under its schedule.
 RETRY_WRAPPER_FUNCS = {
     "_retry",
     "_call_shard",
     "_fan_out",
     "_retry_transient_collective",
+    "call_with_backoff",
 }
 
 #: Terminal receiver names whose ``.call`` is already a managed boundary.
 BOUNDARY_RECEIVERS = {"master", "subprocess"}
 
 _TIMEOUT_KWARGS = {"timeout", "timeout_s"}
+
+#: The one module where the raw readiness primitive is legal: it owns
+#: ``wait_channel_ready``, the shared-backoff wrapper everything else
+#: must route through.
+READINESS_OWNER_SUFFIXES = ("common/rpc.py",)
 
 
 class RpcDisciplinePass(LintPass):
@@ -94,6 +111,25 @@ class RpcDisciplinePass(LintPass):
 
     def _check_call(self, src, node: ast.Call, in_wrapper, findings) -> None:
         func = node.func
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr == "channel_ready_future"
+        ) or (
+            isinstance(func, ast.Name) and func.id == "channel_ready_future"
+        ):
+            # Bare readiness wait (r18): the primitive belongs to
+            # common/rpc.py's wait_channel_ready — a hand-rolled wait has
+            # one hard timeout, no retry accounting, no jitter.
+            path = src.path.replace("\\", "/")
+            if not any(path.endswith(s) for s in READINESS_OWNER_SUFFIXES):
+                findings.append(Finding(
+                    self.name, src.path, node.lineno,
+                    "bare channel_ready_future readiness wait — route "
+                    "through common/rpc.wait_channel_ready (the shared "
+                    "backoff helper owns probing, jitter and retry "
+                    "accounting)",
+                ))
+            return
         is_rpc = False
         label = ""
         if isinstance(func, ast.Attribute) and func.attr in ("call", "call_async"):

@@ -35,6 +35,12 @@ semicolon-separated faults, comma-separated ``key=value`` args)::
                                          # incarnations (-rN names) do
                                          # NOT re-match, so a kill cannot
                                          # crash-loop its own relaunch
+    kill:target=master,step=3            # r18: kill the MASTER once its
+                                         # dispatcher has counted step=N
+                                         # done tasks (the master:report
+                                         # hook in the servicer) — the
+                                         # masterfail bench's crash.
+                                         # Default target is the worker.
     stall:rank=0,point=prep,step=2,ms=500,count=2
     stall:rank=0,point=collective,shard=1,ms=2000   # stall ONE dp
                                          # shard's contribution at the
@@ -47,6 +53,10 @@ semicolon-separated faults, comma-separated ``key=value`` args)::
 Fault kinds -> hook points (the wire contract with the call sites):
 
     kill       worker:task            os._exit(CHAOS_KILL_EXIT_CODE)
+               master:report          (target=master only; fires in the
+                                      servicer after a report is applied
+                                      AND journaled — the hardest crash
+                                      point for exactly-once)
     stall      worker:{task,prep,step,collective}  time.sleep(ms)
     delay_rpc  rpc:client             time.sleep(ms) before the send
     drop_rpc   rpc:client             raise ChaosRpcDropped (the caller
@@ -93,7 +103,7 @@ class ChaosRpcDropped(RuntimeError):
 
 #: kind -> hook points it may fire at.
 _KIND_POINTS = {
-    "kill": ("worker:task",),
+    "kill": ("worker:task", "master:report"),
     "stall": (
         "worker:task", "worker:prep", "worker:step", "worker:collective",
     ),
@@ -109,7 +119,7 @@ _KIND_POINTS = {
 #: ``delay_ps`` takes no identity/step keys: the PS shard process has no
 #: worker rank and no step mirror, so those conditions could never match.
 _KIND_KEYS = {
-    "kill": {"rank", "worker", "step", "count", "skip"},
+    "kill": {"rank", "worker", "step", "count", "skip", "target"},
     "stall": {
         "rank", "worker", "step", "point", "shard", "ms", "count", "skip",
     },
@@ -133,6 +143,9 @@ class ChaosFault:
     ms: float = 0.0
     count: int = 1
     skip: int = 0
+    # kill only: which PROCESS dies.  "" / "worker" = the worker task
+    # boundary (pre-r18 behavior); "master" = the servicer's report hook.
+    target: str = ""
     # firing state — guarded by the injector's lock
     seen: int = 0
     fired: int = 0
@@ -140,6 +153,15 @@ class ChaosFault:
     def matches(self, point: str, ctx: Dict[str, Any]) -> bool:
         if point not in _KIND_POINTS[self.kind]:
             return False
+        if self.kind == "kill":
+            # A kill binds to ONE process family: target=master fires
+            # only at the servicer's report hook, the default only at the
+            # worker task boundary — a plan must never kill both.
+            wanted = (
+                "master:report" if self.target == "master" else "worker:task"
+            )
+            if point != wanted:
+                return False
         if self.kind == "stall":
             # A stall binds to ONE worker hook point (default: the step
             # dispatch) — "stall the prep" and "stall the step" are
@@ -197,6 +219,18 @@ def parse_plan(spec: str) -> List[ChaosFault]:
             raise ChaosError(
                 f"{entry!r}: point must be task|prep|step|collective, got "
                 f"{fault.point!r}"
+            )
+        if fault.target and fault.target not in ("worker", "master"):
+            raise ChaosError(
+                f"{entry!r}: target must be worker|master, got "
+                f"{fault.target!r}"
+            )
+        if fault.target == "master" and (fault.rank is not None or fault.worker):
+            # The master has neither rank nor worker id: such a condition
+            # could never match — a fault that silently never fires (the
+            # parse-error stance).
+            raise ChaosError(
+                f"{entry!r}: rank=/worker= do not apply to target=master"
             )
         if fault.shard is not None and fault.point != "collective":
             # shard= addresses one dp contributor crossing the r15

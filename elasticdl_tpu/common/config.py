@@ -236,6 +236,24 @@ class JobConfig:
 
     # --- master / control plane ---
     master_addr: str = ""  # host:port of the master gRPC service
+    # Port the master gRPC service binds (0 = ephemeral).  A FIXED port is
+    # what makes a master restart a blip instead of a job failure (r18):
+    # workers ride out the outage re-dialing the address they already
+    # hold, so the relaunched master must answer at the same one.
+    master_port: int = 0
+    # Per-call deadline on every worker->master RPC (RpcMasterProxy).  Was
+    # a hardcoded 60 s before r18; jobs with huge trace envelopes or slow
+    # control planes tune it here.
+    master_call_timeout_s: float = 60.0
+    # Master-outage ride-through budget (r18): on a transport-level
+    # failure (UNAVAILABLE — the master is down/restarting) the worker's
+    # proxy retries the call under the shared exponential-backoff-with-
+    # jitter helper for up to this many seconds of outage, holding its
+    # buffered leases and in-flight prep, then re-registers + reconciles
+    # when the master answers again.  Exceeding the budget is a terminal
+    # error (the task loop fails loud).  0 disables the ride-through
+    # (pre-r18 behavior: first UNAVAILABLE surfaces immediately).
+    master_outage_tolerance_s: float = 120.0
     task_timeout_s: float = 600.0
     # How long the master waits after the job finishes for workers to exit on
     # their own (they are writing final checkpoints — orbax + host-tier
@@ -412,6 +430,15 @@ class JobConfig:
             from elasticdl_tpu.chaos.inject import parse_plan
 
             parse_plan(self.chaos)
+        if self.master_port < 0:
+            raise ValueError("--master_port must be 0 (ephemeral) or a port")
+        if self.master_call_timeout_s <= 0:
+            raise ValueError("--master_call_timeout_s must be positive")
+        if self.master_outage_tolerance_s < 0:
+            raise ValueError(
+                "--master_outage_tolerance_s cannot be negative (0 = no "
+                "ride-through)"
+            )
         if self.gang_deadline_ms < 0:
             raise ValueError("--gang_deadline_ms cannot be negative")
         if self.gang_skip_budget < 0:

@@ -12,11 +12,14 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import random
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import grpc
 
 from elasticdl_tpu import chaos
+from elasticdl_tpu.common import gauge as gaugelib
 from elasticdl_tpu.common import trace
 
 SERVICE_NAME = "elasticdl.Master"
@@ -34,6 +37,20 @@ GRPC_MAX_MESSAGE_BYTES = 64 << 20
 GRPC_MESSAGE_OPTIONS = [
     ("grpc.max_send_message_length", GRPC_MAX_MESSAGE_BYTES),
     ("grpc.max_receive_message_length", GRPC_MAX_MESSAGE_BYTES),
+]
+
+#: CLIENT channel options: the message caps plus a bounded reconnection
+#: backoff.  gRPC's default re-dial schedule backs off to 120 s — after
+#: ~15 s of refused connections the channel can sit in TRANSIENT_FAILURE
+#: for a minute-plus after the server is BACK, failing every call fast
+#: without attempting a connection.  That silently defeats the r18
+#: master-outage ride-through (the proxy's own jittered backoff governs
+#: the retry cadence; the CHANNEL must merely keep probing), so re-dial
+#: attempts are capped at 5 s apart.
+GRPC_CLIENT_CHANNEL_OPTIONS = GRPC_MESSAGE_OPTIONS + [
+    ("grpc.initial_reconnect_backoff_ms", 500),
+    ("grpc.min_reconnect_backoff_ms", 500),
+    ("grpc.max_reconnect_backoff_ms", 5000),
 ]
 
 #: Wire-contract version, negotiated at RegisterWorker (the one RPC every
@@ -63,6 +80,7 @@ _INT = (int,)
 _NUM = (int, float)
 _BOOL = (bool,)
 _DICT = (dict,)
+_LIST = (list,)
 
 #: The master wire contract (kept in lockstep with MasterServicer's method
 #: table — asserted by tests).  Unknown fields pass through (forward
@@ -100,14 +118,38 @@ MASTER_SCHEMAS: Dict[str, MessageSchema] = {
             # report so the master's JobStatus and the train-job artifact
             # can attribute throughput to named phases without a new RPC.
             "phase_times": _DICT,
+            # seq (r18): per-worker monotonically increasing report
+            # sequence number.  The master journals the highest seq seen
+            # per worker (master/journal.py) and DEDUPES a replayed seq
+            # — the exactly-once guard that lets the proxy's outage
+            # ride-through retry a report whose first attempt the dying
+            # master may or may not have applied.  Additive and
+            # optional: an absent field keeps the pre-r18 at-least-once
+            # semantics, so no PROTOCOL_VERSION bump (the r9 stance).
+            "seq": _INT,
         },
     ),
     "ReportVersion": MessageSchema(
         required={"model_version": _INT}, optional={"worker_id": _STR}
     ),
+    # incarnation/held_tasks (r18): the lease-reconciliation handshake a
+    # worker runs after its proxy rode out a master outage (and, with an
+    # empty list, at every fresh boot).  ``held_tasks`` is the exact set
+    # of training-task ids the worker still holds (buffered leases,
+    # in-flight preps, the pipelined pending slot); the master requeues
+    # its journal-replayed ``doing`` entries for this worker that the
+    # worker does NOT hold (handouts lost in flight during the crash,
+    # requeued now instead of after task_timeout_s) and answers with
+    # ``stale_tasks`` — held ids the master no longer attributes to this
+    # worker, which the worker must drop unstarted (training them would
+    # double-train records the master already re-leased).  Additive:
+    # absent fields skip the reconcile entirely.
     "RegisterWorker": MessageSchema(
         required={"worker_id": _STR},
-        optional={"address": _STR, "proto": _INT},
+        optional={
+            "address": _STR, "proto": _INT,
+            "incarnation": _STR, "held_tasks": _LIST,
+        },
     ),
     "DeregisterWorker": MessageSchema(required={"worker_id": _STR}),
     "Heartbeat": MessageSchema(
@@ -205,6 +247,147 @@ SERVING_SCHEMAS: Dict[str, MessageSchema] = {
 
 class SchemaError(ValueError):
     """A message violated its method's schema (the structured boundary error)."""
+
+
+# -- the ONE retry/backoff policy (r18) -------------------------------------
+#
+# Before r18 the repo had three hand-rolled retry loops — the PS client's
+# fixed backoff table, the worker's transient-collective retry, and a
+# hard-failing channel-readiness wait — each with its own schedule, its own
+# (or no) jitter, and its own observability.  They are now ONE code path:
+# ``call_with_backoff`` owns exponential backoff + jitter + max-attempts +
+# a wall budget, emits ``edl_rpc_retry_total{service=}`` into the
+# process-default gauge registry and an ``rpc:retry`` trace instant per
+# retry, and every adopter (PS ``RemoteEmbeddingStore._retry``, the
+# worker's ``_retry_transient_collective``, ``RpcMasterProxy``'s outage
+# ride-through and every readiness wait via ``wait_channel_ready``) just
+# declares its schedule and its transience predicate.  The graftlint
+# ``rpc-discipline`` rule enforces the readiness half: the raw
+# ``grpc.channel_ready_future`` primitive is legal only in this module.
+
+
+@dataclasses.dataclass(frozen=True)
+class BackoffPolicy:
+    """Exponential backoff schedule: ``base_s * multiplier**n`` capped at
+    ``max_s``, each delay jittered by ``±jitter`` (a fraction).  Retrying
+    stops at ``max_attempts`` total attempts (0 = unbounded) or once
+    ``budget_s`` of wall clock has elapsed since the first attempt (0 =
+    no wall budget); at least one of the two should bound the loop."""
+
+    base_s: float = 0.5
+    multiplier: float = 2.0
+    max_s: float = 8.0
+    jitter: float = 0.2
+    max_attempts: int = 0
+    budget_s: float = 0.0
+
+
+def call_with_backoff(
+    fn: Callable[[], Any],
+    *,
+    service: str,
+    is_transient: Callable[[BaseException], bool],
+    policy: BackoffPolicy,
+    on_retry: Optional[Callable[[BaseException, int, float], None]] = None,
+    terminal: Optional[Callable[[BaseException, int, float], BaseException]] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    clock: Callable[[], float] = time.monotonic,
+    budget_s_fn: Optional[Callable[[], float]] = None,
+) -> Any:
+    """Run ``fn()``, retrying errors ``is_transient`` accepts under
+    ``policy``.  Non-transient errors surface immediately.  On exhaustion
+    the ORIGINAL error re-raises (so adopters' callers keep their error
+    contracts), unless ``terminal`` builds a clearer one — it is raised
+    ``from`` the original.  ``on_retry(error, attempt, delay_s)`` runs
+    before each sleep (adopter-specific logging/instants); the shared
+    ``edl_rpc_retry_total{service=}`` counter and ``rpc:retry`` instant
+    fire here for every adopter.  ``budget_s_fn`` makes the wall budget
+    DYNAMIC — re-read every attempt, so a caller can shrink it under an
+    in-flight retry loop (the preemption path cutting a parked
+    ride-through short); it overrides ``policy.budget_s``."""
+    attempt = 0
+    start = clock()
+    while True:
+        try:
+            return fn()
+        except BaseException as e:  # noqa: BLE001 — filtered by predicate
+            if not is_transient(e):
+                raise
+            attempt += 1
+            elapsed = clock() - start
+            # A STATIC budget of 0 means "no wall budget" (attempts bound
+            # the loop); a DYNAMIC budget is always active — its 0 means
+            # "exhausted NOW" (the preemption path shrinking an in-flight
+            # ride-through must fail it fast, never unbound it).
+            if budget_s_fn is not None:
+                budget_s = budget_s_fn()
+                budget_active = True
+            else:
+                budget_s = policy.budget_s
+                budget_active = bool(budget_s)
+            exhausted = (
+                policy.max_attempts and attempt >= policy.max_attempts
+            ) or (budget_active and elapsed >= budget_s)
+            if exhausted:
+                if terminal is not None:
+                    raise terminal(e, attempt, elapsed) from e
+                raise
+            delay = min(
+                policy.base_s * policy.multiplier ** (attempt - 1),
+                policy.max_s,
+            )
+            if policy.jitter:
+                delay *= 1.0 + random.uniform(-policy.jitter, policy.jitter)
+            if budget_active:
+                delay = min(delay, max(0.0, budget_s - elapsed))
+            gaugelib.default().counter(
+                "edl_rpc_retry_total",
+                "transient-error retries through the shared backoff helper",
+                labels={"service": service},
+            ).inc()
+            trace.instant(
+                "rpc:retry", cat="rpc.client", service=service,
+                attempt=attempt, delay_ms=round(delay * 1e3, 1),
+                error=type(e).__name__,
+            )
+            if on_retry is not None:
+                on_retry(e, attempt, delay)
+            sleep(delay)
+
+
+def wait_channel_ready(
+    channel,
+    *,
+    service: str,
+    budget_s: float,
+    per_try_s: float = 5.0,
+    terminal: Optional[Callable[[BaseException, int, float], BaseException]] = None,
+    sleep: Callable[[float], None] = time.sleep,
+) -> None:
+    """THE readiness wait: short ``channel_ready_future`` probes under the
+    shared backoff until the channel is ready or ``budget_s`` elapses.
+    One hard ``result(timeout=budget)`` (the pre-r18 shape) spends the
+    whole budget inside grpc with no retry accounting and no jitter — a
+    thundering herd of relaunched workers all re-dialing a restarting
+    master at once is exactly when the jitter matters.  graftlint's
+    rpc-discipline rule pins every readiness wait to this helper."""
+
+    def probe():
+        grpc.channel_ready_future(channel).result(
+            timeout=min(per_try_s, budget_s) if budget_s else per_try_s
+        )
+
+    call_with_backoff(
+        probe,
+        service=service,
+        is_transient=lambda e: isinstance(e, grpc.FutureTimeoutError),
+        policy=BackoffPolicy(
+            base_s=0.2, multiplier=2.0, max_s=2.0, jitter=0.2,
+            budget_s=budget_s,
+        ),
+        terminal=terminal,
+        sleep=sleep,
+    )
 
 
 def validate_message(
@@ -323,7 +506,7 @@ class JsonRpcClient:
         schemas: Optional[Dict[str, MessageSchema]] = None,
     ):
         self._channel = grpc.insecure_channel(
-            address, options=GRPC_MESSAGE_OPTIONS
+            address, options=GRPC_CLIENT_CHANNEL_OPTIONS
         )
         self._service = service_name
         self._stubs: Dict[str, Callable] = {}
@@ -332,7 +515,9 @@ class JsonRpcClient:
         self._schemas = schemas
 
     def wait_ready(self, timeout_s: float = 10.0) -> None:
-        grpc.channel_ready_future(self._channel).result(timeout=timeout_s)
+        wait_channel_ready(
+            self._channel, service=self._service, budget_s=timeout_s
+        )
 
     def call(self, method: str, request: Dict[str, Any], timeout_s: float = 30.0):
         if self._schemas is not None:

@@ -81,6 +81,14 @@ class Master:
     ):
         config.validate()
         self.config = config
+        if config.chaos:
+            # graftchaos (r18): the master is now a fault TARGET too
+            # (kill:target=master fires at the servicer's report hook).
+            # Worker-addressed faults can never match master hook points,
+            # so arming the whole plan here is safe.
+            from elasticdl_tpu import chaos
+
+            chaos.configure(config.chaos)
         if config.trace:
             # Master-side spans (rpc.server handlers, dispatcher lease
             # events) join the same merged trace the workers ship into —
@@ -122,15 +130,35 @@ class Master:
             else ""
         )
         self._last_progress: Optional[str] = None
-        resume = self._load_progress(len(shards), config.num_epochs)
-        self.dispatcher = TaskDispatcher(
-            shards,
-            num_epochs=config.num_epochs if config.job_type == "training" else 1,
-            task_type=task_type,
-            task_timeout_s=config.task_timeout_s,
-            task_skip_budget=config.gang_skip_budget,
-            resume=resume,
+        # Durable control-plane journal (r18, master/journal.py): the
+        # fsync'd WAL of every hand-out/report/requeue/gang-log-entry
+        # supersedes the coarse watermark on restart — a restarted master
+        # resumes the EXACT pre-crash dispatcher state (in-flight leases
+        # and all) and reconciles reconnecting workers against it.  The
+        # watermark stays as the fallback (journal missing/corrupt) and
+        # the model-checkpoint consistency anchor.
+        from elasticdl_tpu.master.journal import JOURNAL_FILENAME
+
+        self._journal = None
+        self._journal_path = (
+            os.path.join(config.checkpoint_dir, JOURNAL_FILENAME)
+            if self._progress_path
+            else ""
         )
+        num_epochs = config.num_epochs if config.job_type == "training" else 1
+        replayed = self._replay_journal(shards, num_epochs, task_type)
+        if replayed is not None:
+            self.dispatcher = replayed.dispatcher
+        else:
+            resume = self._load_progress(len(shards), config.num_epochs)
+            self.dispatcher = TaskDispatcher(
+                shards,
+                num_epochs=num_epochs,
+                task_type=task_type,
+                task_timeout_s=config.task_timeout_s,
+                task_skip_budget=config.gang_skip_budget,
+                resume=resume,
+            )
         self.evaluation: Optional[EvaluationService] = None
         if config.job_type == "training" and config.validation_data:
             eval_reader = create_data_reader(
@@ -168,6 +196,75 @@ class Master:
         # only moment the (model state, data progress) pair is consistent on
         # disk (see _persist_progress).
         self.servicer.set_checkpoint_callback(self._persist_progress)
+        if replayed is not None and config.chaos:
+            # A master kill must not crash-loop its own relaunch (the
+            # worker-kill family's incarnation guard, mirrored): the
+            # replayed dispatcher already satisfies step=N, so a restarted
+            # master re-arming the same plan would die at its first
+            # applied report, and the next, forever.  Master-targeted
+            # kills disarm on any journal-replayed restart.
+            from elasticdl_tpu import chaos
+            from elasticdl_tpu.chaos.inject import parse_plan
+
+            plan = parse_plan(config.chaos)
+            kept = [
+                f for f in plan
+                if not (f.kind == "kill" and f.target == "master")
+            ]
+            if len(kept) != len(plan):
+                logger.warning(
+                    "disarming %d master-kill chaos fault(s) on a "
+                    "restarted master (a kill must not crash-loop its "
+                    "own relaunch)", len(plan) - len(kept),
+                )
+                chaos.configure(plan=kept)
+        if replayed is not None:
+            # Version numbering continues from the pre-crash world: a
+            # reconnecting worker's re-registration must observe a BUMP
+            # (never a reused number its stale view could mistake for its
+            # own), and the replayed group log's version stays comparable.
+            self.rendezvous.seed_version(replayed.membership_version)
+            self.servicer.adopt_replayed(replayed)
+            reg = self.servicer.fleet.registry
+            reg.counter(
+                "edl_master_restarts_total",
+                "journal-replayed master restarts of this job",
+            ).inc(replayed.restarts + 1)
+            reg.gauge(
+                "edl_master_journal_replay_ms",
+                "wall time of the last journal replay",
+            ).set(self._journal_replay_ms)
+        if self._journal_path:
+            from elasticdl_tpu.master.journal import MasterJournal
+
+            self._journal = MasterJournal(self._journal_path)
+            self.servicer.set_journal(self._journal)
+            self.dispatcher.attach_journal(self._journal)
+            if replayed is None or not replayed.events_applied:
+                # Fresh job / watermark fallback / base-only restart:
+                # start a clean WAL from the current (checkpoint-
+                # consistent) state.
+                self.servicer.rotate_journal()
+            else:
+                # FULL replay: deliberately NO rotation — the WAL's base
+                # must stay the last CHECKPOINT-COUPLED snapshot.  A base
+                # rotated here would bake the replayed post-checkpoint
+                # progress (live only in the surviving workers' memory)
+                # into the very record a LATER whole-node restart's
+                # base-only mode trusts as checkpoint-consistent — the
+                # rolled-forward-ledger hazard in a new coat.  Continued
+                # events append to the existing file (replay chains
+                # across master generations); the next checkpoint report
+                # compacts as usual.
+                logger.info(
+                    "continuing the existing WAL (full replay): the base "
+                    "stays checkpoint-coupled; next checkpoint compacts"
+                )
+                # The restart itself is an event (pre-server: no handler
+                # threads yet, so no lock discipline applies) — replay
+                # counts these on top of the base's restarts, keeping the
+                # counter honest across rotation-free restart chains.
+                self._journal.record({"kind": "restart"})
         self.server = MasterServer(
             self.servicer, port=port, advertise_host=self._advertise_host(config)
         )
@@ -223,8 +320,22 @@ class Master:
         self.pod_manager = PodManager(
             pod_backend if pod_backend is not None else self._build_backend(config),
             config,
+            # Pod reattach registry (r18): persisted beside the journal so
+            # worker supervision survives a master crash — the restarted
+            # master ADOPTS the live orphans instead of spawning a second
+            # fleet next to the workers riding out the restart.
+            state_path=(
+                os.path.join(
+                    config.checkpoint_dir, PodManager.REGISTRY_FILENAME
+                )
+                if self._journal_path
+                else None
+            ),
         )
         self.pod_manager.add_listener(self._on_pod_event)
+        # Resolves an adopted orphan's unknowable exit code: after the job
+        # finished a disappearance is the worker's clean exit.
+        self.pod_manager.set_job_finished_fn(self.servicer.job_finished)
         # Warm-standby pool depth rides Heartbeat/JobStatus (r13): a
         # drained pool must be visible BEFORE the next failure finds it
         # empty and pays a cold relaunch.
@@ -261,6 +372,104 @@ class Master:
                     "pod-fleet state (PodManager.counts)",
                     labels={"fleet": prefix},
                 ).set(float(v))
+
+    def _fleet_died_with_old_master(self) -> Optional[bool]:
+        """Whole-job-restart probe: True when the pod reattach registry
+        POSITIVELY shows the previous fleet dead (>= 1 recorded pid, none
+        alive), False when at least one worker is riding the outage out,
+        None when the registry offers no evidence (absent/empty — fake
+        and k8s backends, in-process tests).  This is what decides
+        whether the journal's post-checkpoint events are trustworthy: a
+        surviving worker's in-memory model HAS those updates; a dead
+        fleet restores from the checkpoint and does not.  Liveness runs
+        through PodManager.scan_registry — the SAME zombie- and
+        cmdline-guarded probe the adoption path uses, so a recycled pid
+        cannot fake a live fleet and full-replay untrained shards away."""
+        from elasticdl_tpu.master.pod_manager import PodManager
+
+        scan = PodManager.scan_registry(
+            os.path.join(
+                self.config.checkpoint_dir, PodManager.REGISTRY_FILENAME
+            )
+        )
+        if not scan["recorded"]:
+            return None
+        return not scan["alive"]
+
+    def _replay_journal(self, shards, num_epochs: int, task_type: str):
+        """Rebuild the pre-crash control plane from the WAL, or None to
+        fall back (no journal / corrupt / different job shape / any
+        unexpected shape skew — each falls back LOUDLY to the coarse
+        watermark, never half-replays and never crash-loops the restart
+        on a bad file)."""
+        self._journal_replay_ms = 0.0
+        if not self._journal_path or not os.path.exists(self._journal_path):
+            return None
+        from elasticdl_tpu.master import journal as journal_mod
+
+        # Whole-job restart (fleet positively dead): the workers will
+        # restore the MODEL from the last checkpoint, so control-plane
+        # progress past the checkpoint-coupled journal BASE describes
+        # gradient updates that died with them — replaying it would skip
+        # shards the restored model never saw.  Base-only replay keeps
+        # the checkpoint-consistency contract; the skipped tail simply
+        # re-trains (at-least-once, the pre-r18 stance).  A live worker
+        # (master-only crash) keeps the full, exact replay.
+        base_only = self._fleet_died_with_old_master() is True
+        if base_only:
+            logger.warning(
+                "previous worker fleet is gone: replaying the journal "
+                "BASE only (checkpoint-consistent) — post-checkpoint "
+                "control-plane progress re-trains rather than pairing a "
+                "rolled-back model with a rolled-forward task ledger",
+            )
+        t0 = time.perf_counter()
+        try:
+            replayed = journal_mod.replay(
+                self._journal_path,
+                shards,
+                num_epochs=num_epochs,
+                task_type=task_type,
+                task_timeout_s=self.config.task_timeout_s,
+                task_skip_budget=self.config.gang_skip_budget,
+                base_only=base_only,
+            )
+        except Exception:
+            # Deliberately broad: a journal that PARSES but violates the
+            # expected shape (format skew, partial corruption) surfaces
+            # as KeyError/TypeError deep in the restore — any such file
+            # must degrade to the watermark once, loudly, not crash-loop
+            # every subsequent restart through the same exception.
+            logger.exception(
+                "journal %s unusable; falling back to the coarse "
+                "watermark", self._journal_path,
+            )
+            return None
+        self._journal_replay_ms = round((time.perf_counter() - t0) * 1e3, 2)
+        counts = replayed.dispatcher.counts()
+        logger.info(
+            "master restart: replayed %d journal event(s) in %.1f ms — "
+            "done=%d doing=%d todo=%d, group log %d entr%s, restart #%d%s",
+            replayed.events_applied, self._journal_replay_ms,
+            counts["done"], counts["doing"], counts["todo"],
+            len(replayed.group_log),
+            "y" if len(replayed.group_log) == 1 else "ies",
+            replayed.restarts + 1,
+            " (torn tail tolerated)" if replayed.torn_tail else "",
+        )
+        from elasticdl_tpu.common import trace as _trace
+
+        # The masterfail bench's replay-stage clock (wall-anchored ts, so
+        # cross-process decomposition needs no alignment).
+        _trace.instant(
+            "master:replay", cat="elastic",
+            events=replayed.events_applied,
+            replay_ms=self._journal_replay_ms,
+            done=counts["done"], doing=counts["doing"],
+            restarts=replayed.restarts + 1,
+            torn_tail=replayed.torn_tail,
+        )
+        return replayed
 
     def _load_progress(self, num_shards: int, num_epochs: int):
         if not self._progress_path or not os.path.exists(self._progress_path):
@@ -315,6 +524,12 @@ class Master:
             f.write(payload)
         os.replace(tmp, self._progress_path)
         self._last_progress = payload
+        # Journal compaction rides the same checkpoint-coupled cadence:
+        # the WAL restarts from a fresh full-state base whenever the
+        # watermark advances, so it stays bounded by one checkpoint
+        # interval's control-plane traffic (master/journal.py).
+        if self._journal is not None:
+            self.servicer.rotate_journal()
 
     @staticmethod
     def _advertise_host(config: JobConfig) -> str:
@@ -357,15 +572,23 @@ class Master:
             return
         import grpc
 
+        from elasticdl_tpu.common.rpc import wait_channel_ready
+
         for addr in self.config.ps_addresses.split(","):
+            channel = grpc.insecure_channel(addr)
             try:
-                channel = grpc.insecure_channel(addr)
-                grpc.channel_ready_future(channel).result(timeout=timeout_s)
-                channel.close()
-            except grpc.FutureTimeoutError:
-                raise RuntimeError(
-                    f"PS shard at {addr} not reachable after {timeout_s:.0f}s"
+                # Short probes under the shared backoff (r18): a shard
+                # paying its startup restore keeps getting re-probed
+                # instead of one hard wait, and the terminal error names
+                # the shard.
+                wait_channel_ready(
+                    channel, service="ps", budget_s=timeout_s,
+                    terminal=lambda e, n, t, addr=addr: RuntimeError(
+                        f"PS shard at {addr} not reachable after {t:.0f}s"
+                    ),
                 )
+            finally:
+                channel.close()
 
     @staticmethod
     def _build_backend(config: JobConfig) -> PodBackend:
@@ -456,6 +679,8 @@ class Master:
         self.server.stop()
         if self.metrics_writer is not None:
             self.metrics_writer.close()
+        if self._journal is not None:
+            self._journal.close()
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -466,7 +691,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     from elasticdl_tpu.common.log_utils import set_level
 
     set_level(config.log_level)
-    master = Master(config)
+    # --master_port (r18): a fixed port is what makes a master RESTART
+    # transparent to the fleet — workers ride out the outage redialing
+    # the address they already hold.  0 keeps the ephemeral-bind default.
+    master = Master(config, port=config.master_port)
     status = master.run()
     return 0 if not status.get("abandoned") else 1
 

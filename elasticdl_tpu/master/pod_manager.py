@@ -53,14 +53,67 @@ class PodPhase:
     # Worker asked to be restarted (multihost elastic re-join, exit code 3);
     # relaunched WITHOUT consuming the slot's failure budget.
     RESTART = "Restart"
+    # An ADOPTED pod (a pre-restart orphan this master re-attached to,
+    # r18) disappeared.  Its exit code is unknowable — it was never this
+    # process's child — so the backend cannot tell a clean job-end exit
+    # from a crash; PodManager._on_event resolves LOST to SUCCEEDED when
+    # the job is already finished, else FAILED (relaunch path).  Never
+    # reaches listeners unresolved.
+    LOST = "Lost"
 
-    TERMINAL = (SUCCEEDED, FAILED, DELETED, RESTART)
+    TERMINAL = (SUCCEEDED, FAILED, DELETED, RESTART, LOST)
 
 
 # Exit code the worker main uses to request a budget-free relaunch
 # (worker.worker.RESTART_EXIT_CODE; duplicated to keep this module
 # importable without jax).
 WORKER_RESTART_EXIT_CODE = 3
+
+#: The pod reattach registry's filename under checkpoint_dir (r18): the
+#: ONE spelling Master's wiring, the whole-job-restart probe and the
+#: masterfail bench all reference.
+REGISTRY_FILENAME = "pod_registry.json"
+
+
+def proc_cmdline(pid: int) -> Optional[str]:
+    """Best-effort /proc cmdline fingerprint (None off-Linux or for a
+    vanished pid): the pid-reuse guard for every registry-pid probe."""
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            return (
+                f.read().replace(b"\0", b" ").decode(errors="replace").strip()
+            )
+    except OSError:
+        return None
+
+
+def pid_alive(pid: int, cmdline: Optional[str] = None) -> bool:
+    """THE pid-liveness probe for reattach decisions (r18) — one
+    definition so the adoption check, the whole-job-restart probe and the
+    bench cannot drift.  ``kill(pid, 0)`` alone lies twice: a ZOMBIE
+    (exited, unreaped) still answers it, and a RECYCLED pid answers for a
+    stranger.  /proc state 'Z' filters the first (best-effort; off-Linux
+    the zombie case cannot arise for the processes this guards — adopted
+    orphans reparent to init and are reaped there); a ``cmdline``
+    fingerprint, when the caller recorded one, filters the second."""
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        return False
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            # Field 3 (after the parenthesized comm, which may itself
+            # contain spaces): the process state.
+            state = f.read().rpartition(")")[2].split()[0]
+        if state == "Z":
+            return False
+    except (OSError, IndexError):
+        pass  # no /proc: fall through to the kill(0) verdict
+    if cmdline:
+        have = proc_cmdline(pid)
+        if have is not None and have != cmdline:
+            return False  # pid recycled by an unrelated process
+    return True
 
 
 @dataclasses.dataclass
@@ -180,6 +233,12 @@ class ProcessPodBackend(PodBackend):
         self._standby: List[tuple] = []  # guarded-by: _lock
         self._standby_dir: Optional[str] = None  # guarded-by: _lock
         self._standby_seq = 0  # guarded-by: _lock
+        # Adopted orphans (r18 master restart): name -> pid of a worker
+        # process a PREVIOUS master spawned that this one re-attached to
+        # (PodManager reattach registry).  Not our children — liveness is
+        # kill(pid, 0) polling in the watcher, exit codes are unknowable
+        # (PodPhase.LOST), teardown is signal-based.
+        self._adopted: Dict[str, int] = {}  # guarded-by: _lock
 
     def _pod_stdio(self, name: str):
         if self._log_dir is None:
@@ -376,6 +435,26 @@ class ProcessPodBackend(PodBackend):
                 self._watcher.start()
         self._emit(name, PodPhase.RUNNING)
 
+    def adopt_pod(self, name: str, pid: int) -> None:
+        """Re-attach to a live orphan of a previous master (r18 crash
+        survivability): supervision continues — liveness via kill(0)
+        polling, teardown via signals — WITHOUT spawning a duplicate
+        worker next to the one riding out the restart.  The pod's worker
+        process notices nothing: it re-registers with the new master
+        through its own proxy reconnect."""
+        with self._lock:
+            self._adopted[name] = pid
+            if self._watcher is None:
+                self._watcher = threading.Thread(
+                    target=self._watch, name="pod-watcher", daemon=True
+                )
+                self._watcher.start()
+        logger.info("adopted orphan pod %s (pid %d)", name, pid)
+        trace.instant("pod:adopt", cat="elastic", pod=name, pid=pid)
+        self._emit(name, PodPhase.RUNNING)
+
+    _pid_alive = staticmethod(pid_alive)
+
     #: SIGTERM->SIGKILL grace on delete: must exceed the worker's
     #: preemption-snapshot bound (worker.main PREEMPTION_EXIT_S = 15 s) or
     #: a scale-down would tear the snapshot it just triggered mid-write.
@@ -386,6 +465,7 @@ class ProcessPodBackend(PodBackend):
     def delete_pod(self, name: str) -> None:
         with self._lock:
             proc = self._procs.pop(name, None)
+            adopted_pid = self._adopted.pop(name, None)
         if proc is not None and proc.poll() is None:
             proc.terminate()
             try:
@@ -393,12 +473,34 @@ class ProcessPodBackend(PodBackend):
             except subprocess.TimeoutExpired:
                 proc.kill()
                 proc.wait(timeout=5)
+        elif adopted_pid is not None:
+            # Not our child: no wait() — SIGTERM, poll liveness through
+            # the same grace the child path gets, then SIGKILL.
+            self._signal_adopted(adopted_pid)
         self._emit(name, PodPhase.DELETED)
+
+    def _signal_adopted(self, pid: int) -> None:
+        import signal
+
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except OSError:
+            return  # already gone
+        deadline = time.monotonic() + self.TERMINATE_GRACE_S
+        while time.monotonic() < deadline:
+            if not self._pid_alive(pid):
+                return
+            time.sleep(0.1)
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except OSError:
+            pass
 
     def _watch(self) -> None:
         while not self._stop.is_set():
             try:
                 done = []
+                lost = []
                 with self._lock:
                     for name, proc in self._procs.items():
                         rc = proc.poll()
@@ -406,6 +508,18 @@ class ProcessPodBackend(PodBackend):
                             done.append((name, rc))
                     for name, _ in done:
                         del self._procs[name]
+                    for name, pid in list(self._adopted.items()):
+                        if not self._pid_alive(pid):
+                            lost.append((name, pid))
+                            del self._adopted[name]
+                for name, pid in lost:
+                    # Exit code unknowable (never our child): LOST, which
+                    # PodManager resolves against job state.
+                    logger.info(
+                        "adopted pod %s (pid %d) disappeared -> %s",
+                        name, pid, PodPhase.LOST,
+                    )
+                    self._emit(name, PodPhase.LOST)
                 for name, rc in done:
                     if rc == 0:
                         phase = PodPhase.SUCCEEDED
@@ -427,7 +541,9 @@ class ProcessPodBackend(PodBackend):
     def pid(self, name: str) -> Optional[int]:
         with self._lock:
             proc = self._procs.get(name)
-            return proc.pid if proc is not None else None
+            if proc is not None:
+                return proc.pid
+            return self._adopted.get(name)
 
     def standby_depth(self) -> Optional[int]:
         """Live parked spares right now (the Heartbeat/JobStatus gauge);
@@ -445,11 +561,21 @@ class ProcessPodBackend(PodBackend):
             self._procs.clear()
             procs.extend(p for p, _, _ in self._standby)
             self._standby = []
+            adopted = list(self._adopted.values())
+            self._adopted.clear()
             standby_dir, self._standby_dir = self._standby_dir, None
         for proc in procs:
             if proc.poll() is None:
                 proc.kill()
                 self._reap(proc)
+        for pid in adopted:
+            if self._pid_alive(pid):
+                import signal
+
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except OSError:
+                    pass
         if standby_dir is not None:
             import shutil
 
@@ -693,12 +819,17 @@ class PodManager:
     the 4→8→4 elasticity path.
     """
 
+    #: Canonical registry filename (module constant re-exported where the
+    #: wiring already has the class in hand).
+    REGISTRY_FILENAME = REGISTRY_FILENAME
+
     def __init__(
         self,
         backend: PodBackend,
         config: JobConfig,
         worker_env: Optional[Dict[str, str]] = None,
         name_prefix: Optional[str] = None,
+        state_path: Optional[str] = None,
     ):
         self._backend = backend
         self._config = config
@@ -707,6 +838,19 @@ class PodManager:
         self._lock = locksan.lock("PodManager._lock", leaf=True)  # lock-order: leaf
         self._slots: Dict[int, Optional[PodInfo]] = {}  # guarded-by: _lock
         self._by_name: Dict[str, PodInfo] = {}  # guarded-by: _lock
+        # Pod reattach registry (r18 master crash survivability): the
+        # per-slot (name, pid, gen, cmdline) of every live pod, persisted
+        # to ``state_path`` so supervision OUTLIVES this master process —
+        # a restarted master ADOPTS the still-running orphans (backend
+        # adopt_pod: kill(0)-polled liveness, signal teardown) instead of
+        # spawning a duplicate fleet beside the workers riding out the
+        # restart.  None = no persistence (pre-r18 behavior).
+        self._state_path = state_path
+        self._reattach: Dict[str, dict] = self._load_registry()  # guarded-by: _lock
+        # Resolves an adopted pod's unknowable exit (PodPhase.LOST): the
+        # master wires servicer.job_finished here — a disappearance after
+        # the job is done is a clean exit, before it is a crash.
+        self._job_finished_fn: Optional[Callable[[], bool]] = None
         # Per-slot launch generation, NEVER reset (survives scale-down/up
         # cycles): every pod a slot ever gets has a unique name, so late
         # events for a retired pod can't resolve to its successor and a k8s
@@ -723,6 +867,114 @@ class PodManager:
 
     def add_listener(self, fn: PodListener) -> None:
         self._listeners.append(fn)
+
+    def set_job_finished_fn(self, fn: Callable[[], bool]) -> None:
+        """Wire the LOST-resolution probe (see _on_event); called at
+        wiring time, before any pod events flow."""
+        self._job_finished_fn = fn
+
+    # -- reattach registry (r18) --
+
+    def _load_registry(self) -> Dict[str, dict]:
+        if not self._state_path or not os.path.exists(self._state_path):
+            return {}
+        import json
+
+        try:
+            with open(self._state_path) as f:
+                data = json.load(f)
+            slots = data.get("slots") or {}
+            return {str(k): dict(v) for k, v in slots.items()}
+        except (OSError, ValueError, AttributeError):
+            logger.warning("unreadable pod registry %s; ignoring", self._state_path)
+            return {}
+
+    _proc_cmdline = staticmethod(proc_cmdline)
+
+    @staticmethod
+    def scan_registry(state_path: Optional[str]) -> dict:
+        """One-shot registry liveness scan (r18): ``{"recorded": n,
+        "alive": [pids], "dead": [pids]}`` with the SAME adoptability
+        probe ``_adoptable_locked`` applies (zombie + cmdline-fingerprint
+        guarded pid_alive) — Master's whole-job-restart decision and any
+        tool read the fleet's fate through this one definition."""
+        out = {"recorded": 0, "alive": [], "dead": []}
+        if not state_path or not os.path.exists(state_path):
+            return out
+        import json
+
+        try:
+            with open(state_path) as f:
+                slots = (json.load(f).get("slots") or {}).values()
+        except (OSError, ValueError, AttributeError):
+            return out
+        for s in slots:
+            if not isinstance(s, dict):
+                continue
+            pid = s.get("pid")
+            if not isinstance(pid, int) or pid <= 0:
+                continue
+            out["recorded"] += 1
+            bucket = (
+                "alive" if pid_alive(pid, cmdline=s.get("cmdline")) else "dead"
+            )
+            out[bucket].append(pid)
+        return out
+
+    def _persist_registry(self) -> None:
+        """Atomically persist the live-pod table.  Reads pids OUTSIDE the
+        manager lock (the backend takes its own): the registry is
+        advisory — a torn race loses one adoption opportunity, never
+        correctness (the unmatched orphan is simply not adopted and the
+        slot cold-spawns beside it only if its pid probe failed, i.e. it
+        was already gone)."""
+        if not self._state_path:
+            return
+        with self._lock:
+            live = [
+                (i.slot, i.name, i.relaunches, self._slot_gen.get(i.slot, 0))
+                for i in self._slots.values()
+                if i is not None and i.phase not in PodPhase.TERMINAL
+            ]
+        pid_fn = getattr(self._backend, "pid", None)
+        slots = {}
+        for slot, name, relaunches, gen in live:
+            pid = pid_fn(name) if pid_fn is not None else None
+            if pid is None:
+                continue
+            slots[str(slot)] = {
+                "name": name, "pid": pid, "relaunches": relaunches,
+                "gen": gen, "cmdline": self._proc_cmdline(pid),
+            }
+        import json
+
+        try:
+            os.makedirs(os.path.dirname(self._state_path) or ".", exist_ok=True)
+            # Thread-unique tmp: the watcher thread's terminal-event
+            # persist can race a scale()/launch persist IN THIS PROCESS —
+            # a shared pid-only tmp name would let them interleave writes
+            # and os.replace corrupt JSON into the registry, which the
+            # next master's scan would read as "no evidence" and pick a
+            # FULL replay for a genuinely dead fleet.
+            tmp = (
+                f"{self._state_path}.tmp{os.getpid()}."
+                f"{threading.get_ident()}"
+            )
+            with open(tmp, "w") as f:
+                json.dump({"slots": slots}, f, sort_keys=True)
+            os.replace(tmp, self._state_path)
+        except OSError:
+            # Advisory state: a failed write costs the NEXT master its
+            # adoption shortcut, never this one its launch.
+            logger.exception("pod registry write failed (%s)", self._state_path)
+
+    def _adoptable_locked(self, entry: dict) -> bool:  # guarded-by: _lock
+        if not hasattr(self._backend, "adopt_pod"):
+            return False
+        pid = entry.get("pid")
+        if not isinstance(pid, int) or pid <= 0:
+            return False
+        return pid_alive(pid, cmdline=entry.get("cmdline"))
 
     def _notify(self, name: str, phase: str) -> None:
         for fn in self._listeners:
@@ -743,22 +995,58 @@ class PodManager:
             raise ValueError("cannot scale below 0 workers")
         to_start: List[PodInfo] = []
         to_delete: List[str] = []
+        to_adopt: List[tuple] = []
         with self._lock:
             old = self._desired
             self._desired = n
             for slot in range(old, n):  # grow
+                # Reattach first (r18): a live orphan of the pre-restart
+                # master fills the slot WITHOUT a duplicate spawn — the
+                # worker in it is already riding out the restart on its
+                # proxy reconnect.  The registry entry is one-shot; a
+                # dead/reused pid falls through to a normal launch.
+                entry = self._reattach.pop(str(slot), None)
+                if entry is not None:
+                    # Seed the slot's generation from the registry EITHER
+                    # way: a dead entry falls through to a fresh launch,
+                    # and reusing the dead generation's exact pod name
+                    # would break the every-pod-unique-name invariant
+                    # (late events for the retired pod would resolve to
+                    # its unrelated successor, and the successor's worker
+                    # id would collide with the dead incarnation's).
+                    gen = int(entry.get("gen", 0))
+                    self._slot_gen[slot] = max(
+                        self._slot_gen.get(slot, -1), gen
+                    )
+                if entry is not None and self._adoptable_locked(entry):
+                    info = PodInfo(
+                        name=entry["name"], slot=slot,
+                        relaunches=int(entry.get("relaunches", 0)),
+                    )
+                    self._slots[slot] = info
+                    self._by_name[info.name] = info
+                    to_adopt.append((info, int(entry["pid"])))
+                    continue
                 info = self._new_pod_locked(slot, relaunches=0)
                 to_start.append(info)
             for slot in range(n, old):  # shrink: retire highest slots
                 info = self._slots.pop(slot, None)
                 if info is not None and info.phase not in PodPhase.TERMINAL:
                     to_delete.append(info.name)
+        for info, pid in to_adopt:
+            self._backend.adopt_pod(info.name, pid)
         for info in to_start:
             self._launch(info)
         for name in to_delete:
             self._backend.delete_pod(name)
+        if to_adopt or to_start or to_delete:
+            self._persist_registry()
         if n != old:
-            logger.info("scaled worker fleet %d -> %d", old, n)
+            logger.info(
+                "scaled worker fleet %d -> %d%s", old, n,
+                f" ({len(to_adopt)} slot(s) re-attached to live orphans)"
+                if to_adopt else "",
+            )
 
     # How many times a single pod launch is retried against backend errors
     # (transient k8s API outages, fork failures) before the failure is
@@ -780,6 +1068,7 @@ class PodManager:
                 return  # slot was scaled away or superseded while backing off
         try:
             self._backend.start_pod(info.name, self._pod_env(info))
+            self._persist_registry()
         except Exception:
             logger.exception(
                 "launch of %s failed (attempt %d/%d)",
@@ -838,10 +1127,32 @@ class PodManager:
         for name in live:
             self._backend.delete_pod(name)
         self._backend.close()
+        if self._state_path:
+            # A CLEAN stop tears the fleet down — leaving the registry
+            # behind would point the next master at recycled pids.
+            try:
+                os.remove(self._state_path)
+            except OSError:
+                pass
 
     # -- event handling --
 
     def _on_event(self, name: str, phase: str) -> None:
+        if phase == PodPhase.LOST:
+            # Adopted-orphan disappearance: the exit code is unknowable
+            # (never this process's child).  After the job is finished a
+            # disappearance IS the worker's clean exit; before it, treat
+            # as a crash so the relaunch/requeue machinery engages.
+            fn = self._job_finished_fn
+            phase = (
+                PodPhase.SUCCEEDED
+                if fn is not None and fn()
+                else PodPhase.FAILED
+            )
+            logger.info(
+                "adopted pod %s lost -> resolved %s (exit code "
+                "unknowable for a re-attached orphan)", name, phase,
+            )
         relaunch_info: Optional[PodInfo] = None
         with self._lock:
             info = self._by_name.get(name)
@@ -895,6 +1206,10 @@ class PodManager:
             # without unwinding into the watcher thread (the only thread
             # observing pod events) and without consuming relaunch budget.
             self._launch(relaunch_info)
+        elif phase in PodPhase.TERMINAL:
+            # A retired pod must leave the reattach registry NOW: a later
+            # master adopting its recycled pid would supervise a stranger.
+            self._persist_registry()
 
     # -- introspection --
 

@@ -179,6 +179,15 @@ class RendezvousServer:
         self._notify(version, members)
         return dead
 
+    def seed_version(self, version: int) -> None:
+        """Continue version numbering from a journal-replayed pre-crash
+        value (r18 master restart).  Monotone and wiring-time only (no
+        members yet): a reconnecting worker's re-registration must see a
+        version strictly ABOVE anything its pre-crash view held — a
+        reused number would read as "nothing changed" to a stale peer."""
+        with self._lock:
+            self._version = max(self._version, int(version))
+
     def set_expected(self, n: int) -> None:
         """Record the fleet's desired size (master wires scale() here)."""
         with self._lock:

@@ -47,6 +47,7 @@ from typing import Dict, Optional
 
 import grpc
 
+from elasticdl_tpu import chaos
 from elasticdl_tpu.common import locksan, trace
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.rpc import (
@@ -177,6 +178,38 @@ class MasterServicer:
         # PodManager's depth here; Heartbeat/JobStatus republish it so a
         # DRAINED pool is visible before the next failure needs it.
         self._standby_depth_fn = None  # guarded-by: _lock
+        # Durable control-plane journal (r18, master/journal.py): the
+        # servicer records its OWN nondeterministic inputs — lockstep
+        # group-log entries, membership/model-version advances, the
+        # per-worker report-seq ledger — beside the dispatcher's queue
+        # events, all into one WAL.  None until the master wires it
+        # (before the server starts); the REFERENCE is then read-only —
+        # single-op reads from any handler thread — and rotation swaps
+        # the fd INSIDE the journal while holding every recording lock
+        # (rotate_journal), never this reference.
+        self._journal = None  # single-writer: main
+        # Per-worker highest report seq accepted (r18): the exactly-once
+        # dedup ledger.  A worker's proxy retries a report whose first
+        # attempt a dying master may or may not have applied; the seq
+        # makes the retry idempotent — journaled with the report, so the
+        # ledger survives the restart the retry is riding out.
+        self._report_seqs: Dict[str, int] = {}  # guarded-by: _lock
+        # Stale (seq-deduped) reports rejected since start — the
+        # observable half of "rejects a stale pre-restart report exactly
+        # once" (JobStatus republishes it).
+        self._stale_reports = 0  # guarded-by: _lock
+        # Last incarnation nonce each worker id registered with: a CHANGED
+        # incarnation is a fresh process whose seq counter restarts at 1,
+        # so its ledger entry resets — without this, a respawned worker
+        # under a replayed ledger would have its first reports silently
+        # deduped as pre-restart duplicates.  Deliberately NOT journaled:
+        # a ride-through worker's retried report dedups BEFORE its
+        # reconcile re-registration can reset anything (the task loop is
+        # blocked inside that very call), and post-reset seqs only grow.
+        self._worker_incarnations: Dict[str, str] = {}  # guarded-by: _lock
+        # Journal replay stats stamped by a restarted master (JobStatus
+        # republishes; the masterfail bench asserts on them).
+        self._journal_stats: Dict[str, object] = {}  # guarded-by: _lock
         # graftgauge (r14): the fleet metrics plane.  Workers ship their
         # registry snapshot on the same heartbeat/report channel as the
         # trace slices (the additive ``gauge`` envelope); FleetMetrics
@@ -224,6 +257,15 @@ class MasterServicer:
             # not instantly "skip" a member of the new one).
             self._gang_arrivals = {}
             self._gang_head = (0, None)
+            if gv is not None:
+                self._journal_record({"kind": "group_version", "version": None})
+        with self._lock:
+            # Under _lock like every servicer-side record: rotation holds
+            # it, so this membership advance cannot land on the old fd
+            # after the base snapshot was composed (and then exist in
+            # neither file — a lost version that a restarted master would
+            # re-issue to stale peers).
+            self._journal_record({"kind": "membership", "version": version})
         if gv is not None and gv != version:
             lost = self.dispatcher.recover_tasks(self.group_worker_id(gv))
             if self.evaluation is not None:
@@ -232,6 +274,68 @@ class MasterServicer:
                 logger.info(
                     "requeued %d lockstep tasks of group v%d", len(lost), gv
                 )
+
+    # -- durable journal (r18) --
+
+    def _journal_record(self, ev: dict) -> None:
+        """Record one servicer-side journal event.  Callers hold the lock
+        of the subsystem whose state the event describes (``_group_lock``
+        for group entries, ``_lock`` for version/seq advances) — the same
+        under-the-owning-lock ordering contract the dispatcher keeps, and
+        what makes the no-lock fd append safe (master/journal.py)."""
+        if self._journal is not None:
+            self._journal.record(ev)
+
+    def set_journal(self, journal) -> None:
+        """Wire the WAL (master main, after construction/replay).  The
+        dispatcher shares the same journal object (attach_journal)."""
+        with self._lock:
+            self._journal = journal
+
+    def adopt_replayed(self, replayed) -> None:
+        """Adopt a ``journal.ReplayResult``'s servicer half: the restored
+        lockstep log (so a reconnecting gang can keep walking its seq),
+        the model version, and the report-seq dedup ledger.  Called
+        before the server starts — no concurrent handlers yet."""
+        with self._group_lock:
+            self._group_version = replayed.group_version
+            self._group_log = list(replayed.group_log)
+        with self._lock:
+            self._model_version = max(
+                self._model_version, replayed.model_version
+            )
+            self._report_seqs = dict(replayed.report_seqs)
+            self._worker_incarnations = dict(replayed.incarnations)
+            self._journal_stats = {
+                "restarts": replayed.restarts + 1,
+                "replayed_events": replayed.events_applied,
+                "torn_tail": replayed.torn_tail,
+            }
+
+    def rotate_journal(self) -> None:
+        """Compaction: swap the WAL for a fresh file whose base record is
+        the CURRENT full control-plane state.  Holds ``_group_lock`` +
+        ``_lock`` across the dispatcher-side rotate (which holds the
+        dispatcher's own lock around its snapshot + the fd swap), so
+        every journal writer — each records under one of those three
+        locks — is excluded while the file changes hands: no event can
+        land between the base snapshot and the swap and be lost."""
+        with self._group_lock:
+            with self._lock:
+                if self._journal is None:
+                    return
+                extras = {
+                    "group_version": self._group_version,
+                    "group_log": [dict(e) for e in self._group_log],
+                    "model_version": self._model_version,
+                    "membership_version": self.rendezvous.version(),
+                    "report_seqs": dict(self._report_seqs),
+                    "incarnations": dict(self._worker_incarnations),
+                    "restarts": int(
+                        self._journal_stats.get("restarts", 0) or 0
+                    ),
+                }
+                self.dispatcher.rotate_journal(extras)
 
     # -- handlers (dict in, dict out) --
 
@@ -322,6 +426,9 @@ class MasterServicer:
                 self._group_log = []
                 self._gang_arrivals = {}
                 self._gang_head = (0, None)
+                self._journal_record(
+                    {"kind": "group_version", "version": version}
+                )
             if seq > len(self._group_log):
                 # A process can only be at most one entry ahead of the log;
                 # anything else is a protocol bug or a stale world — restart.
@@ -360,6 +467,15 @@ class MasterServicer:
                         break  # transient: not logged, caller retries seq
                     entry = {"task": resp["task"], "finished": resp["finished"]}
                     self._group_log.append(entry)
+                    # Journaled at materialization: every rank of a
+                    # reconnecting gang resumes the SAME seq walk against
+                    # the replayed log (the whole-gang lockstep contract
+                    # must survive the master, not just the dispatcher).
+                    self._journal_record({
+                        "kind": "group_entry",
+                        "seq": len(self._group_log) - 1,
+                        "entry": dict(entry),
+                    })
                     entries.append(entry)
                 s += 1
                 if entries[-1]["finished"]:
@@ -501,6 +617,46 @@ class MasterServicer:
         # report, beside the "phase" record — the same crash-safe channel
         # and cadence.
         self._record_gauges(req, stream=True)
+        # Report-seq dedup (r18): the worker numbers its reports, the
+        # proxy's outage ride-through may RETRY one whose first attempt
+        # the dying master already applied+journaled — the replayed seq
+        # ledger rejects the duplicate here, before any counter moves, so
+        # exactly-once holds across the restart without inflating
+        # duplicate_done (that counter keeps meaning what r13 defined:
+        # late success for a task requeued by timeout/skip).
+        seq = req.get("seq")
+        worker_id = req.get("worker_id", "")
+        if seq is not None and worker_id:
+            seq = int(seq)
+            # CHECK here, ADVANCE only after the report has applied (and
+            # therefore journaled, inside dispatcher.report's critical
+            # section).  Advancing first opened a crash window where a
+            # rotation between ledger update and report journal persisted
+            # a base whose ledger was AHEAD of its task state — the
+            # retried report then deduped against work the replay never
+            # counted (silent double-train).  With check-then-apply-then-
+            # advance, the worst interleaving is the mirror image — a
+            # base with the report counted but the ledger behind — and a
+            # replayed retry lands in the r13 late-success path instead:
+            # rejected, observable in duplicate_done, nothing retrained.
+            # Per-worker seqs arrive serialized (one task loop, and the
+            # preemption hand-off parks it), so check-then-later-advance
+            # does not race itself.
+            with self._lock:
+                stale = seq <= self._report_seqs.get(worker_id, 0)
+                if stale:
+                    self._stale_reports += 1
+            if stale:
+                trace.instant(
+                    "lease:dedup", cat="lease", worker=worker_id,
+                    task=task_id, seq=seq,
+                )
+                logger.info(
+                    "deduplicated stale report seq %d from %s (task %d) — "
+                    "already applied before the restart", seq, worker_id,
+                    task_id,
+                )
+                return {"accepted": True, "duplicate": True}
         if task_type == TASK_EVALUATION and self.evaluation is not None:
             # Metrics BEFORE report_task: completing the round's last task
             # snapshots the aggregate.
@@ -516,11 +672,30 @@ class MasterServicer:
                 )
             accepted = self.evaluation.report_task(task_id, success)
             self._maybe_write_eval_metrics()
+            if seq is not None and worker_id:
+                # Eval rounds are not journal-replayed (a restart re-runs
+                # them), but the seq LEDGER must still survive or a
+                # retried eval report could double-apply after a restart.
+                with self._lock:
+                    self._journal_record(
+                        {"kind": "report_seq", "worker": worker_id,
+                         "seq": seq}
+                    )
+                    self._report_seqs[worker_id] = max(
+                        self._report_seqs.get(worker_id, 0), seq
+                    )
         else:
             accepted = self.dispatcher.report(
                 task_id, success, req.get("worker_id", ""),
                 requeue_only=bool(req.get("requeue", False)),
+                seq=seq if worker_id else None,
             )
+            if seq is not None and worker_id:
+                # Advance AFTER the apply+journal (see the check above).
+                with self._lock:
+                    self._report_seqs[worker_id] = max(
+                        self._report_seqs.get(worker_id, 0), seq
+                    )
             if success and accepted and req.get("metrics") and self.metrics_writer:
                 with self._lock:
                     fallback_version = self._model_version
@@ -531,6 +706,17 @@ class MasterServicer:
                 )
         if "model_version" in req:
             self._bump_version(int(req["model_version"]))
+        # graftchaos (r18): kill:target=master,step=N fires HERE, after
+        # the report is applied AND journaled — the crash the masterfail
+        # bench injects lands exactly where a real one is hardest: a
+        # worker whose acked-but-unanswered report must dedup, not
+        # double-train, across the restart.  ``step`` is the dispatcher's
+        # cumulative done count; gated so the unarmed path never pays the
+        # counts() lock.
+        if chaos.enabled():
+            chaos.hook(
+                "master:report", step=self.dispatcher.counts()["done"]
+            )
         return {"accepted": accepted}
 
     # hot-path: called from every report AND every heartbeat
@@ -779,8 +965,15 @@ class MasterServicer:
 
     def _bump_version(self, version: int) -> None:
         with self._lock:
+            advanced = version > self._model_version
             self._model_version = max(self._model_version, version)
             current = self._model_version
+            if advanced:
+                # The restored version seeds max_steps/eval triggers on
+                # restart; monotone, so replay max()es duplicates away.
+                self._journal_record(
+                    {"kind": "model_version", "version": current}
+                )
             # Check-and-set under the lock: two reports crossing max_steps
             # concurrently must not both win the "first to hit" test (the
             # log fired twice and dispatcher.stop() ran twice).
@@ -819,7 +1012,58 @@ class MasterServicer:
         self.rendezvous.register(req["worker_id"], req.get("address", ""))
         with self._lock:
             self._known_workers.add(req["worker_id"])
-        return self.rendezvous.membership()
+        membership = self.rendezvous.membership()
+        incarnation = req.get("incarnation")
+        if incarnation:
+            with self._lock:
+                prev = self._worker_incarnations.get(req["worker_id"])
+                if prev != incarnation:
+                    self._worker_incarnations[req["worker_id"]] = incarnation
+                    stale_ledger = self._report_seqs.pop(
+                        req["worker_id"], None
+                    )
+                    # The reset is JOURNALED (under _lock, rotation-safe):
+                    # without it a replay would max() the base's dead-
+                    # incarnation seq back over the fresh incarnation's
+                    # low seqs and wrongly dedup its in-flight retry — a
+                    # second-order double-train window.
+                    self._journal_record({
+                        "kind": "incarnation",
+                        "worker": req["worker_id"],
+                        "incarnation": incarnation,
+                    })
+                else:
+                    stale_ledger = None
+            if stale_ledger is not None:
+                logger.info(
+                    "worker %s registered a fresh incarnation (%s): "
+                    "report-seq ledger reset from %d (its counter "
+                    "restarts at 1)",
+                    req["worker_id"], incarnation, stale_ledger,
+                )
+        # Lease reconciliation (r18): a worker declaring what it HOLDS —
+        # the reconnect handshake after a master restart (held = its
+        # buffered leases + in-flight preps + pending report), and the
+        # fresh-boot declaration (held = []), which requeues a dead
+        # incarnation's leases NOW instead of after task_timeout_s.  The
+        # response's stale_tasks names held work this master no longer
+        # attributes to the worker; training it would double-train.
+        held = req.get("held_tasks")
+        if held is not None:
+            requeued, stale_ids = self.dispatcher.reconcile_leases(
+                req["worker_id"],
+                {int(t) for t in held if isinstance(t, (int, float))},
+            )
+            if requeued or stale_ids:
+                logger.info(
+                    "reconciled %s (incarnation %s): requeued %d lost "
+                    "lease(s) %s, %d stale held id(s) %s",
+                    req["worker_id"], req.get("incarnation", "?"),
+                    len(requeued), [t.task_id for t in requeued],
+                    len(stale_ids), stale_ids,
+                )
+            membership = dict(membership, stale_tasks=stale_ids)
+        return membership
 
     def DeregisterWorker(self, req: dict) -> dict:
         """Active leave.  A lockstep group member that failed a task calls
@@ -999,6 +1243,13 @@ class MasterServicer:
             # r15 graftreduce: in-collective exclusions per worker (the
             # in-step layer of the same bounded-skip accounting).
             status["collective_skips"] = dict(self._collective_skips)
+            # r18 master crash survivability: seq-deduped stale reports
+            # (the exactly-once proof's second counter, beside
+            # duplicate_done) and the journal replay stats of a restarted
+            # master (restarts / replayed_events / torn_tail).
+            status["stale_reports"] = self._stale_reports
+            if self._journal_stats:
+                status["journal"] = dict(self._journal_stats)
             depth_fn = self._standby_depth_fn
         if depth_fn is not None:
             depth = depth_fn()

@@ -26,6 +26,12 @@ TASK_EVALUATION = "evaluation"
 TASK_PREDICTION = "prediction"
 
 
+class JournalReplayError(RuntimeError):
+    """A journal event does not fit the state being rebuilt — the WAL
+    describes a different job (or is corrupt past its header's job-shape
+    guard).  The restarting master falls back to the coarse watermark."""
+
+
 @dataclasses.dataclass(frozen=True)
 class Task:
     task_id: int
@@ -71,6 +77,8 @@ class TaskDispatcher:
         task_skip_budget: int = 2,
         clock: Callable[[], float] = time.monotonic,
         resume: Optional[dict] = None,
+        restore: Optional[dict] = None,
+        journal=None,
     ):
         if num_epochs < 1:
             raise ValueError("num_epochs must be >= 1")
@@ -112,7 +120,18 @@ class TaskDispatcher:
         # (--evaluation_steps=0).
         self._on_epoch_end: Optional[Callable[[int, bool], None]] = None
         self._pending_epoch_end: List[Tuple[int, bool]] = []
-        if resume is not None and self._shards:
+        # Durable control-plane journal (r18, master/journal.py): every
+        # queue mutation records one event under this lock, in mutation
+        # order, so a restarted master replays to the EXACT pre-crash
+        # state (not the coarse watermark's "skip finished epochs").
+        # None = no journal (tests, eval/predict jobs); attached after
+        # construction on the replay path (replay must not re-record).
+        self._journal = journal  # guarded-by: _lock
+        if restore is not None:
+            # Journal-replay restore: the full pre-crash state, bit for
+            # bit — supersedes the watermark resume below.
+            self._restore_snapshot(restore)
+        elif resume is not None and self._shards:
             self._resume(resume)
         else:
             self._refill()
@@ -170,6 +189,188 @@ class TaskDispatcher:
 
     def set_epoch_end_callback(self, fn: Callable[[int, bool], None]) -> None:
         self._on_epoch_end = fn
+
+    # -- durable journal (r18): snapshot / restore / event replay --
+
+    def attach_journal(self, journal) -> None:
+        """Wire the WAL after construction (the replay path builds the
+        dispatcher journal-less, then attaches the rotated journal)."""
+        with self._lock:
+            self._journal = journal
+
+    def _j(self, ev: dict) -> None:  # guarded-by: _lock
+        """Record one journal event.  Called under ``_lock`` immediately
+        after the mutation it describes, so the WAL's physical order IS
+        the mutation order (the replay contract)."""
+        if self._journal is not None:
+            self._journal.record(ev)
+
+    def rotate_journal(self, extras: dict) -> None:
+        """Compaction inner half: snapshot + WAL swap in ONE critical
+        section of this lock, so no dispatcher event can land between the
+        snapshot and the new file (it would be lost from both).  The
+        caller (MasterServicer.rotate_journal) holds the group + servicer
+        locks across this call, excluding ITS writers the same way."""
+        with self._lock:
+            if self._journal is None:
+                return
+            base = dict(extras)
+            base["dispatcher"] = self._snapshot_locked()
+            self._journal.rotate(base)
+
+    def snapshot(self) -> dict:
+        """The FULL dispatcher state, JSON-safe — the journal's base
+        record.  Everything ``counts()``/``progress()`` summarize plus the
+        queues themselves, so a restore is bit-identical (pinned by
+        test), not a watermark approximation."""
+        with self._lock:
+            return self._snapshot_locked()
+
+    def _snapshot_locked(self) -> dict:  # guarded-by: _lock
+        return {
+            "epoch": self._epoch,
+            "todo": [t.to_dict() for t in self._todo],
+            "doing": [
+                {"task": d.task.to_dict(), "worker": d.worker_id}
+                for d in self._doing.values()
+            ],
+            "done_count": self._done_count,
+            "done_in_epoch": sorted(list(k) for k in self._done_in_epoch),
+            "failed_counts": {
+                str(k): v for k, v in self._failed_counts.items()
+            },
+            "skip_counts": {str(k): v for k, v in self._skip_counts.items()},
+            "skipped_events": self._skipped_events,
+            "duplicate_done": self._duplicate_done,
+            "abandoned": self._abandoned,
+            "next_task_id": self._next_task_id,
+            "finished": self._finished,
+            "stopped": self._stopped,
+            "num_epochs": self._num_epochs,
+            "num_shards": len(self._shards),
+            "task_type": self._task_type,
+        }
+
+    def _restore_snapshot(self, snap: dict) -> None:
+        """Adopt a ``snapshot()`` verbatim (journal-replay restore).  The
+        job-shape guard lives in master/journal.py's replay — by the time
+        a snapshot reaches here it describes THIS job."""
+        self._epoch = int(snap["epoch"])
+        self._todo = deque(Task.from_dict(t) for t in snap["todo"])
+        # handed_at resets to now: the pre-crash lease ages died with the
+        # old master's clock, and restarting the timeout window is the
+        # conservative choice (a requeue fires late, never spuriously).
+        now = self._clock()
+        self._doing = {
+            d["task"]["task_id"]: _Doing(
+                Task.from_dict(d["task"]), d["worker"], now
+            )
+            for d in snap["doing"]
+        }
+        self._done_count = int(snap["done_count"])
+        self._done_in_epoch = {tuple(k) for k in snap["done_in_epoch"]}
+        self._failed_counts = {
+            int(k): v for k, v in snap["failed_counts"].items()
+        }
+        self._skip_counts = {int(k): v for k, v in snap["skip_counts"].items()}
+        self._skipped_events = int(snap["skipped_events"])
+        self._duplicate_done = int(snap["duplicate_done"])
+        self._abandoned = int(snap["abandoned"])
+        self._next_task_id = int(snap["next_task_id"])
+        self._finished = bool(snap["finished"])
+        self._stopped = bool(snap["stopped"])
+
+    def replay_event(self, ev: dict) -> None:
+        """Apply one journaled event (master/journal.py's replay loop).
+        Only the nondeterministic inputs were journaled — hand-out
+        choices, reports, requeues — and every derived transition (epoch
+        refill, retry/skip budgets, poison abandons) re-derives through
+        the same code that produced it, so replayed state is bit-exact.
+        Runs with the journal DETACHED (events must not re-record)."""
+        kind = ev["kind"]
+        if kind == "handout":
+            with self._lock:
+                for td in ev["tasks"]:
+                    task_id = td["task_id"]
+                    entry = None
+                    for i, t in enumerate(self._todo):
+                        if t.task_id == task_id:
+                            entry = t
+                            del self._todo[i]
+                            break
+                    if entry is None:
+                        raise JournalReplayError(
+                            f"handout of task {task_id} not in todo — the "
+                            "journal does not describe this job"
+                        )
+                    self._doing[task_id] = _Doing(
+                        entry, ev["worker"], self._clock()
+                    )
+        elif kind == "report":
+            self.report(
+                int(ev["task_id"]), bool(ev["success"]),
+                ev.get("worker", ""),
+                requeue_only=bool(ev.get("requeue", False)),
+            )
+        elif kind == "recover":
+            self.recover_tasks(ev["worker"])
+        elif kind == "skip":
+            self.skip_tasks(ev["worker"])
+        elif kind == "timeout":
+            with self._lock:
+                self._requeue_specific_locked(ev["tasks"])
+        elif kind == "reconcile":
+            self.reconcile_leases(ev["worker"], set(ev["held"]))
+        elif kind == "stop":
+            self.stop()
+        else:
+            raise JournalReplayError(f"unknown journal event kind {kind!r}")
+
+    def _requeue_specific_locked(self, task_ids) -> None:  # guarded-by: _lock
+        """Replay a timeout requeue: the journaled ids move doing -> todo
+        (front), exactly as ``_requeue_timed_out`` moved them."""
+        for tid in task_ids:
+            entry = self._doing.pop(tid, None)
+            if entry is not None and not self._stopped:
+                self._todo.appendleft(entry.task)
+
+    def reconcile_leases(self, worker_id: str, held_ids: set):
+        """Lease reconciliation (r18): the re-register handshake after a
+        master restart.  ``held_ids`` is what the worker still holds; any
+        ``doing`` entry of this worker NOT held was a handout lost in
+        flight during the crash — requeue it NOW (budget-free, the r9
+        requeue_only stance) instead of after task_timeout_s.  Returns
+        ``(requeued_tasks, stale_ids)``: stale ids are held tasks this
+        dispatcher no longer attributes to the worker (already reported,
+        or re-leased after a double restart) — the worker must drop them
+        unstarted or their records would train twice."""
+        held = {int(h) for h in held_ids}
+        with self._lock:
+            lost = [
+                d.task for d in self._doing.values()
+                if d.worker_id == worker_id and d.task.task_id not in held
+            ]
+            for task in lost:
+                del self._doing[task.task_id]
+                if not self._stopped:
+                    self._todo.appendleft(task)
+            stale = sorted(
+                h for h in held
+                if h not in self._doing
+                or self._doing[h].worker_id != worker_id
+            )
+            self._j({
+                "kind": "reconcile", "worker": worker_id,
+                "held": sorted(held),
+            })
+            self._refill()
+        if lost or stale:
+            trace.instant(
+                "lease:reconcile", cat="lease", worker=worker_id,
+                requeued=[t.task_id for t in lost], stale=stale,
+            )
+        self._fire_epoch_end()
+        return lost, stale
 
     # -- internal --
 
@@ -236,6 +437,14 @@ class TaskDispatcher:
                     task, worker_id, self._clock()
                 )
                 tasks.append(task)
+            if tasks:
+                # The WHICH of the hand-out is the nondeterministic input
+                # replay cannot re-derive (full task dicts: the replayed
+                # doing set must not depend on todo ordering assumptions).
+                self._j({
+                    "kind": "handout", "worker": worker_id,
+                    "tasks": [t.to_dict() for t in tasks],
+                })
         if tasks:
             # Lease lifecycle, instant-event form (non-blocking ring append
             # — hot-path legal): handout -> report/requeue/recover, so the
@@ -254,6 +463,7 @@ class TaskDispatcher:
         success: bool,
         worker_id: str = "",
         requeue_only: bool = False,
+        seq: Optional[int] = None,
     ) -> bool:
         """Record a task result; requeue on failure.  Returns False for an
         unknown/stale id (e.g. a task already requeued by the timeout path —
@@ -271,6 +481,15 @@ class TaskDispatcher:
             success=success, requeue=requeue_only,
         )
         with self._lock:
+            # Journaled BEFORE the branch so the rejected-late-success
+            # accounting (duplicate_done) replays identically too; ``seq``
+            # rides along so replay rebuilds the per-worker dedup ledger
+            # from the same record (master/journal.py).
+            self._j({
+                "kind": "report", "task_id": task_id, "success": success,
+                "worker": worker_id, "requeue": requeue_only,
+                **({"seq": seq} if seq is not None else {}),
+            })
             entry = self._doing.pop(task_id, None)
             if entry is None:
                 if success:
@@ -319,6 +538,8 @@ class TaskDispatcher:
                 del self._doing[task.task_id]
                 if not self._stopped:
                     self._todo.appendleft(task)
+            if lost:
+                self._j({"kind": "recover", "worker": worker_id})
         if lost:
             trace.instant(
                 "lease:recover", cat="lease", worker=worker_id,
@@ -345,6 +566,8 @@ class TaskDispatcher:
                 d.task for d in self._doing.values()
                 if d.worker_id == worker_id
             ]
+            if lost:
+                self._j({"kind": "skip", "worker": worker_id})
             for task in lost:
                 del self._doing[task.task_id]
                 self._skipped_events += 1
@@ -377,6 +600,9 @@ class TaskDispatcher:
             for tid, d in self._doing.items()
             if now - d.handed_at > self._timeout
         ]
+        if stale:
+            # Clock-driven, hence invisible to replay unless journaled.
+            self._j({"kind": "timeout", "tasks": list(stale)})
         for tid in stale:
             task = self._doing.pop(tid).task
             if not self._stopped:
@@ -389,6 +615,7 @@ class TaskDispatcher:
         they drain.  Sticky: no refill, and failed/timed-out/recovered tasks
         do not requeue afterwards."""
         with self._lock:
+            self._j({"kind": "stop"})
             self._todo.clear()
             self._finished = True
             self._stopped = True

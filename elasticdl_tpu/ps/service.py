@@ -62,6 +62,11 @@ from elasticdl_tpu import chaos
 from elasticdl_tpu.common import gauge as gaugelib
 from elasticdl_tpu.common import locksan, trace
 from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.common.rpc import (
+    BackoffPolicy,
+    call_with_backoff,
+    wait_channel_ready,
+)
 
 logger = get_logger("ps.service")
 
@@ -601,7 +606,7 @@ class PSClient:
         self._stubs: Dict[str, Any] = {}
 
     def wait_ready(self, timeout_s: float = 20.0) -> None:
-        grpc.channel_ready_future(self._channel).result(timeout=timeout_s)
+        wait_channel_ready(self._channel, service="ps", budget_s=timeout_s)
 
     def call(
         self,
@@ -685,27 +690,45 @@ class RemoteEmbeddingStore:
     def _retry(self, fn):
         """Run ``fn()``, retrying transient shard outages (UNAVAILABLE — the
         pod is relaunching — or a timed-out call).  Non-transient codes
-        (INVALID_ARGUMENT etc.) surface immediately."""
-        for i, backoff in enumerate(self.RETRY_BACKOFFS_S):
-            try:
-                return fn()
-            except grpc.RpcError as e:
-                if e.code() not in self.TRANSIENT_CODES:
-                    raise
-                # The retry count is trace data: a pull span whose wall
-                # includes shard-relaunch backoffs is only explicable with
-                # the retries visible beside it.
-                trace.instant(
-                    "ps:retry", cat="ps.client", table=self.table,
-                    attempt=i + 1, code=str(e.code()),
-                )
-                self._g_retries.inc()
-                logger.warning(
-                    "PS call failed (%s), retry %d/%d in %.0fs",
-                    e.code(), i + 1, len(self.RETRY_BACKOFFS_S), backoff,
-                )
-                time.sleep(backoff)
-        return fn()
+        (INVALID_ARGUMENT etc.) surface immediately.  The schedule rides
+        the shared backoff helper (r18, common/rpc.call_with_backoff):
+        same 1-2-4-8 s cadence as the pre-r18 RETRY_BACKOFFS_S table
+        (jitter-free, so shard-relaunch timing tests stay deterministic),
+        with the per-table ``edl_ps_retry_total`` counter and ``ps:retry``
+        instant kept beside the helper's shared ``edl_rpc_retry_total``."""
+
+        def _transient(e: BaseException) -> bool:
+            return isinstance(e, grpc.RpcError) and (
+                e.code() in self.TRANSIENT_CODES
+            )
+
+        def _on_retry(e: BaseException, attempt: int, delay: float) -> None:
+            # The retry count is trace data: a pull span whose wall
+            # includes shard-relaunch backoffs is only explicable with
+            # the retries visible beside it.
+            trace.instant(
+                "ps:retry", cat="ps.client", table=self.table,
+                attempt=attempt, code=str(e.code()),
+            )
+            self._g_retries.inc()
+            logger.warning(
+                "PS call failed (%s), retry %d/%d in %.0fs",
+                e.code(), attempt, len(self.RETRY_BACKOFFS_S), delay,
+            )
+
+        return call_with_backoff(
+            fn,
+            service="ps",
+            is_transient=_transient,
+            policy=BackoffPolicy(
+                base_s=self.RETRY_BACKOFFS_S[0],
+                multiplier=2.0,
+                max_s=self.RETRY_BACKOFFS_S[-1],
+                jitter=0.0,
+                max_attempts=len(self.RETRY_BACKOFFS_S) + 1,
+            ),
+            on_retry=_on_retry,
+        )
 
     def wait_ready(self, timeout_s: float = 20.0) -> None:
         for c in self._clients:

@@ -273,7 +273,13 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     enable_compile_cache()
 
-    master = RpcMasterProxy(config.master_addr)
+    # Call deadline + outage ride-through budget come off the config bus
+    # (r18): the proxy owns both — see RpcMasterProxy.
+    master = RpcMasterProxy(
+        config.master_addr,
+        call_timeout_s=config.master_call_timeout_s,
+        outage_tolerance_s=config.master_outage_tolerance_s,
+    )
     # Register EXACTLY ONCE, before any jax computation.  The membership view
     # from this call both (a) seeds the jax.distributed spec (the PJRT world
     # is fixed once created) and (b) is handed to Worker.run verbatim — a
@@ -283,12 +289,23 @@ def main(argv: Optional[List[str]] = None) -> int:
     # version bump, which in multihost mode restarts the process.
     from elasticdl_tpu.parallel import distributed
 
+    # Incarnation nonce (r18): this boot's identity across every
+    # registration this process makes — the master resets the worker's
+    # report-seq dedup ledger when it changes (a fresh process restarts
+    # its seq counter at 1).
+    incarnation = f"{os.getpid()}-{int(time.time() * 1e3)}"
     membership = master.call(
         "RegisterWorker",
         {
             "worker_id": worker_id,
             "address": distributed.advertised_address() if config.multihost else "",
             "proto": PROTOCOL_VERSION,
+            "incarnation": incarnation,
+            # held_tasks=[] (r18): a fresh boot HOLDS nothing — the master
+            # requeues any journal-replayed leases still attributed to a
+            # previous incarnation of this id NOW, instead of waiting out
+            # task_timeout_s.
+            "held_tasks": [],
         },
     )
     # Liveness is a background thread, decoupled from the task loop: the
@@ -369,7 +386,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     worker = Worker(
         config, master, build_job_reader(config), worker_id=worker_id,
-        gauges=gauge.default(),
+        gauges=gauge.default(), incarnation=incarnation,
     )
     worker_holder["worker"] = worker
     metrics_server = maybe_start(

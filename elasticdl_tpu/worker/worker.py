@@ -21,6 +21,7 @@ from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Iterable, List, NamedTuple, Optional, Sequence
 
+import grpc
 import jax
 import numpy as np
 
@@ -31,7 +32,12 @@ from elasticdl_tpu.common.checkpoint import CheckpointManager
 from elasticdl_tpu.common.config import JobConfig
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.metrics import PhaseTimers, finalize_metrics
-from elasticdl_tpu.common.rpc import PROTOCOL_VERSION, JsonRpcClient
+from elasticdl_tpu.common.rpc import (
+    PROTOCOL_VERSION,
+    BackoffPolicy,
+    JsonRpcClient,
+    call_with_backoff,
+)
 from elasticdl_tpu.data.ingest_pool import IngestPool, plan_chunks
 from elasticdl_tpu.data.prefetch import prefetch
 from elasticdl_tpu.data.reader import AbstractDataReader, Shard
@@ -69,22 +75,162 @@ class RpcMasterProxy:
     rpc-discipline treats ``master``-terminal receivers as owned by this
     proxy).  A master RPC that outlives the deadline surfaces as an error
     at the call site instead of wedging the task loop forever on a
-    half-dead master."""
+    half-dead master.
+
+    Master-outage ride-through (r18): a transport-level failure
+    (UNAVAILABLE — the master process is down or restarting) does NOT
+    surface to the call site while ``outage_tolerance_s`` lasts; the call
+    retries under the shared exponential-backoff-with-jitter helper
+    (common/rpc.call_with_backoff), which parks the calling thread — the
+    task loop blocks at whatever safe boundary it was crossing, holding
+    its buffered leases and in-flight prep, while already-dispatched
+    device work keeps streaming.  The first call that succeeds after
+    failures marks the proxy RECONNECTED (``take_reconnected``): the
+    worker then re-registers with its held-lease inventory so the
+    restarted master reconciles against its replayed journal.  Report
+    retries across the outage are exactly-once by the report-seq dedup
+    (common/rpc MASTER_SCHEMAS), never by hope.  Chaos drop_rpc faults
+    raise ``ChaosRpcDropped`` — not a grpc error, deliberately NOT
+    retried (r13's blackout fleets depend on drops dying client-side)."""
+
+    #: Transport-level codes worth riding out: the server is not there.
+    #: DEADLINE_EXCEEDED is deliberately absent — the call may have
+    #: EXECUTED (only reports are dedup-protected), and a deadline on a
+    #: live master is a latency pathology the caller should see.
+    _TRANSIENT_CODES = (grpc.StatusCode.UNAVAILABLE,)
 
     def __init__(
         self,
         address: str,
         timeout_s: float = 30.0,
         call_timeout_s: float = 60.0,
+        outage_tolerance_s: float = 120.0,
+        gauges: Optional[gaugelib.Registry] = None,
     ):
+        self._address = address
         self._client = JsonRpcClient(address)
-        self._client.wait_ready(timeout_s)
+        # Startup vs a slow master: short readiness probes under the
+        # shared backoff (a master still binding its port is routine at
+        # job start — the old one-shot wait_ready(30) hard-failed a
+        # healthy worker), with a clear terminal error naming the flag.
+        call_with_backoff(
+            lambda: self._client.wait_ready(5.0),
+            service="master",
+            is_transient=lambda e: isinstance(
+                e, (grpc.FutureTimeoutError, grpc.RpcError)
+            ),
+            policy=BackoffPolicy(
+                base_s=0.5, max_s=4.0, budget_s=max(timeout_s, 1.0)
+            ),
+            terminal=lambda e, n, t: RuntimeError(
+                f"master at {address} not reachable after {t:.0f}s "
+                f"({n} attempt(s)) — check --master_addr / the master pod"
+            ),
+        )
         self._call_timeout_s = call_timeout_s
+        self._tolerance_s = outage_tolerance_s
+        # Reconnect flag, read-then-cleared by the task loop's membership
+        # check; sets/reads are single ops (benign race with the beat
+        # thread: worst case one extra reconcile handshake).
+        self._reconnected = False  # gil-atomic
+        self._g_outage = (gauges or gaugelib.default()).counter(
+            "edl_master_outage_seconds_total",
+            "seconds this worker spent riding out master outages "
+            "(proxy reconnect backoff)",
+        )
 
     def call(self, method: str, request: dict) -> dict:
-        return self._client.call(
-            method, request, timeout_s=self._call_timeout_s
+        if self._tolerance_s <= 0:
+            return self._client.call(
+                method, request, timeout_s=self._call_timeout_s
+            )
+        state = {"t0": None}
+
+        def _on_retry(e, attempt, delay):
+            if state["t0"] is None:
+                state["t0"] = time.monotonic()
+                logger.warning(
+                    "master at %s unreachable (%s on %s); riding out up "
+                    "to %.0fs", self._address, type(e).__name__, method,
+                    self._tolerance_s,
+                )
+            self._g_outage.inc(delay)
+
+        def _attempt():
+            if state["t0"] is not None:
+                # Post-failure attempts force a re-dial first: after a few
+                # fail-fast RPCs against a down server, the gRPC channel
+                # parks in TRANSIENT_FAILURE and further fail-fast calls
+                # do NOT trigger a fresh connection — a restarted master
+                # on the same port stays "UNAVAILABLE" forever (observed
+                # on grpcio 1.68).  A readiness probe is what re-dials;
+                # its own timeout while the master is still down is just
+                # the next transient failure.
+                self._client.wait_ready(5.0)
+            return self._client.call(
+                method, request, timeout_s=self._call_timeout_s
+            )
+
+        resp = call_with_backoff(
+            _attempt,
+            service="master",
+            is_transient=self._is_transient,
+            policy=BackoffPolicy(
+                base_s=0.5, multiplier=2.0, max_s=8.0, jitter=0.25,
+            ),
+            # Dynamic, not captured: limit_outage_tolerance (the
+            # preemption path) must cut a ride-through that is ALREADY
+            # parked in this loop short at its next wake, not after the
+            # originally captured 120 s.
+            budget_s_fn=lambda: self._tolerance_s,
+            on_retry=_on_retry,
+            terminal=lambda e, n, t: RuntimeError(
+                f"master outage outlived --master_outage_tolerance_s: "
+                f"{self._address} unreachable for {t:.0f}s across {n} "
+                f"attempt(s) of {method}"
+            ),
         )
+        if state["t0"] is not None:
+            outage_s = time.monotonic() - state["t0"]
+            self._reconnected = True
+            trace.instant(
+                "worker:reconnect", cat="elastic", method=method,
+                outage_s=round(outage_s, 3),
+            )
+            logger.warning(
+                "master back after %.1fs outage (%s); reconcile pending",
+                outage_s, method,
+            )
+        return resp
+
+    @classmethod
+    def _is_transient(cls, e: BaseException) -> bool:
+        if isinstance(e, grpc.FutureTimeoutError):
+            # The post-failure readiness probe timed out: still down.
+            return True
+        return (
+            isinstance(e, grpc.RpcError)
+            and getattr(e, "code", lambda: None)() in cls._TRANSIENT_CODES
+        )
+
+    def take_reconnected(self) -> bool:
+        """True once per ridden-out outage: the caller owes the master a
+        re-register + lease-reconcile handshake."""
+        if not self._reconnected:
+            return False
+        self._reconnected = False
+        return True
+
+    def limit_outage_tolerance(self, budget_s: float) -> None:
+        """Shrink (never grow) the ride-through budget — the preemption
+        path calls this with a couple of seconds: a process that must be
+        GONE inside PREEMPTION_EXIT_S cannot park two minutes in the
+        outage backoff waiting for a master that may be restarting (the
+        snapshot it still owes matters more than the report, whose loss
+        the master's task timeout already covers).  Single float store,
+        read per call; affects every thread of this proxy, which is the
+        point — the whole process is exiting."""
+        self._tolerance_s = min(self._tolerance_s, max(0.0, budget_s))
 
 
 def _minibatches(
@@ -144,6 +290,7 @@ class Worker:
         devices_per_worker: int = 0,
         poll_interval_s: float = 0.05,
         gauges: Optional[gaugelib.Registry] = None,
+        incarnation: Optional[str] = None,
     ):
         self.config = config
         self.master = master
@@ -233,6 +380,20 @@ class Worker:
         # requeue-on-loss/at-least-once.
         self._leased: deque = deque()
         self._tasks_done = 0
+        # Report sequence numbers (r18): every ReportTaskResult carries a
+        # per-worker monotone seq so the master can DEDUPE a retried
+        # report across its own restart (the proxy's outage ride-through
+        # re-sends the in-flight call; the old master may have applied +
+        # journaled it before dying).  See MASTER_SCHEMAS.
+        self._report_seq = 0
+        # Process-incarnation nonce for the reconcile handshake: the
+        # master resets a worker's report-seq dedup ledger when the
+        # incarnation CHANGES (a fresh process restarts its seq counter
+        # at 1).  worker.main passes the one it already registered with;
+        # standalone Workers mint their own.
+        self._incarnation = (
+            incarnation or f"{os.getpid()}-{int(time.time() * 1e3)}"
+        )
         # Python-side step counter mirroring state.step: reading the device
         # scalar would drain the dispatch pipeline at every task boundary.
         self._steps_dispatched = 0  # single-writer: main (prep/ckpt threads read a recent value)
@@ -740,7 +901,78 @@ class Worker:
             payload["clock_offset_us"] = self._trace_clock_offset_us
         return payload
 
+    def _held_task_ids(self) -> List[int]:
+        """Every training-task id this worker still HOLDS: buffered
+        leases, queued preps, and the pipelined pending slot — the
+        reconcile handshake's inventory.  Task-loop thread only."""
+        held: List[int] = []
+        for entry in self._leased:
+            t = entry.get("task")
+            if t:
+                held.append(int(t["task_id"]))
+        held.extend(task.task_id for task, _r, _f in self._prep_queue)
+        if self._pending is not None:
+            held.append(int(self._pending[0]["task_id"]))
+        return held
+
+    def _reconcile_with_master(self) -> None:
+        """Post-outage handshake (r18): the proxy just rode out a master
+        restart — re-register (the rendezvous is fresh) declaring the
+        leases this worker holds, so the restarted master requeues its
+        journal-replayed ``doing`` entries we DON'T hold and tells us
+        which held ones IT no longer attributes to us (``stale_tasks`` —
+        dropped unstarted here; training them would double-train records
+        the master already re-leased).  Group mode declares nothing: the
+        lockstep log owns gang leases, and its version-keyed
+        invalidation requeues them master-side."""
+        held = [] if self._group_mode else self._held_task_ids()
+        resp = self.master.call(
+            "RegisterWorker",
+            {
+                "worker_id": self.worker_id,
+                "address": self._advertised_address(),
+                "proto": PROTOCOL_VERSION,
+                "incarnation": self._incarnation,
+                "held_tasks": held,
+            },
+        )
+        stale = {int(t) for t in resp.get("stale_tasks") or []}
+        dropped = 0
+        if stale and not self._group_mode:
+            kept = deque()
+            for entry in self._leased:
+                t = entry.get("task")
+                if t and int(t["task_id"]) in stale:
+                    dropped += 1
+                    continue
+                kept.append(entry)
+            self._leased = kept
+            kept_prep: deque = deque()
+            for task, report, fut in self._prep_queue:
+                if task.task_id in stale:
+                    fut.cancel()
+                    dropped += 1
+                    continue
+                kept_prep.append((task, report, fut))
+            self._prep_queue = kept_prep
+        trace.instant(
+            "worker:reconcile", cat="elastic",
+            held=len(held), stale=len(stale), dropped=dropped,
+            version=resp.get("version"),
+        )
+        logger.info(
+            "reconciled with restarted master: declared %d held lease(s), "
+            "dropped %d stale", len(held), dropped,
+        )
+
     def _check_membership(self) -> None:
+        # Post-outage reconcile FIRST (r18): the proxy flags the first
+        # successful call after a ridden-out master outage, and the lease
+        # inventory must reach the restarted master before this loop
+        # leases anything new against its replayed queues.
+        take = getattr(self.master, "take_reconnected", None)
+        if take is not None and take():
+            self._reconcile_with_master()
         # The heartbeat carries the version this worker has APPLIED: the
         # master's lockstep task log withholds collective tasks until every
         # member confirms the current topology (see RendezvousServer).
@@ -1023,6 +1255,15 @@ class Worker:
         Runs on the preemption thread, not in the signal handler frame.
         """
         self._preempting = True  # parks the task loop at its next boundary
+        # FIRST, before anything can block: a preempting process has
+        # PREEMPTION_EXIT_S to live, so every remaining master RPC (this
+        # thread's pending flush, the parked loop's abandons) must fail
+        # fast-ish instead of parking in the r18 outage backoff — a
+        # snapshot forfeited to a 120 s reconnect wait would be the exact
+        # pre-r18 behavior regression.
+        limit = getattr(self.master, "limit_outage_tolerance", None)
+        if limit is not None:
+            limit(2.0)
         trace.instant("elastic:preempt", cat="elastic", rank=self._rank)
         if (
             self._group_mode
@@ -1673,27 +1914,35 @@ class Worker:
         and keeps the collective ORDER identical across the gang.  Outside
         group mode there is no collective to re-form: one plain call, so
         every dispatch site routes through here without branching on
-        mode."""
+        mode.  The schedule runs on the shared backoff helper (r18): a
+        fixed 1 s, jitter-free cadence — every gang member classifies the
+        same failure the same way, and identical re-dispatch timing is
+        what keeps the retried collective aligned across ranks."""
         if not self._group_mode:
             return fn()
-        for attempt in range(self._GROUP_TASK_ATTEMPTS):
-            try:
-                return fn()
-            except Exception as e:  # noqa: BLE001 — filtered below
-                msg = str(e)
-                transient = any(
-                    m in msg for m in self._TRANSIENT_COLLECTIVE_MARKERS
-                )
-                if not transient or attempt == self._GROUP_TASK_ATTEMPTS - 1:
-                    raise
-                logger.warning(
-                    "transient collective-formation failure on task %d "
-                    "(attempt %d/%d): %s — retrying",
-                    task_id, attempt + 1, self._GROUP_TASK_ATTEMPTS,
-                    msg[:200],
-                )
-                time.sleep(1.0)
-        raise AssertionError("unreachable")  # pragma: no cover
+
+        def _transient(e: BaseException) -> bool:
+            msg = str(e)
+            return any(m in msg for m in self._TRANSIENT_COLLECTIVE_MARKERS)
+
+        def _on_retry(e: BaseException, attempt: int, _delay: float) -> None:
+            logger.warning(
+                "transient collective-formation failure on task %d "
+                "(attempt %d/%d): %s — retrying",
+                task_id, attempt, self._GROUP_TASK_ATTEMPTS,
+                str(e)[:200],
+            )
+
+        return call_with_backoff(
+            fn,
+            service="collective",
+            is_transient=_transient,
+            policy=BackoffPolicy(
+                base_s=1.0, multiplier=1.0, max_s=1.0, jitter=0.0,
+                max_attempts=self._GROUP_TASK_ATTEMPTS,
+            ),
+            on_retry=_on_retry,
+        )
 
     def _run_group_training_task(self, task: Task) -> Dict[str, float]:
         return self._retry_transient_collective(
@@ -1709,6 +1958,7 @@ class Worker:
         failure site, so the resync contract cannot drift."""
         report["success"] = False
         report.pop("metrics", None)
+        report["seq"] = self._next_report_seq()
         for call, payload in (
             ("ReportTaskResult", report),
             ("DeregisterWorker", {"worker_id": self.worker_id}),
@@ -1722,6 +1972,11 @@ class Worker:
             f"({context}); deregistered for group resync"
         )
 
+    def _next_report_seq(self) -> int:
+        # graftlint: allow[shared-state] the _parked spin-wait handshake serializes the preemption thread's _flush_pending (the only off-loop report path) against the loop (see preemption_snapshot)
+        self._report_seq += 1
+        return self._report_seq
+
     # hot-path: the report RPC is accounted under the metrics phase
     def _report_result(self, report: dict) -> None:
         """ReportTaskResult with the cumulative phase decomposition riding
@@ -1730,6 +1985,7 @@ class Worker:
         computable downstream, not just cumulative sums."""
         report["phase_times"] = self.phases.snapshot()
         report["phase_counts"] = self.phases.counts()
+        report["seq"] = self._next_report_seq()
         # Gauge envelope on every task report (forced past the ship
         # throttle: reports are bounded frequency by construction) — the
         # carrier of the master's per-report JSONL gauge mirror.
@@ -1931,6 +2187,7 @@ class Worker:
             # No device work ran: requeue without charging the retry
             # budget (a genuine failure this is not).
             report["requeue"] = True
+            report["seq"] = self._next_report_seq()
             try:
                 self.master.call("ReportTaskResult", report)
             except Exception:
@@ -1960,6 +2217,7 @@ class Worker:
                 "success": False,
                 # Never started: requeue without charging the retry budget.
                 "requeue": True,
+                "seq": self._next_report_seq(),
             }
             try:
                 self.master.call("ReportTaskResult", report)
@@ -2170,6 +2428,10 @@ class Worker:
                     "worker_id": self.worker_id,
                     "address": self._advertised_address(),
                     "proto": PROTOCOL_VERSION,
+                    "incarnation": self._incarnation,
+                    # A fresh registration holds nothing: stale leases of
+                    # a previous incarnation requeue now (r18 reconcile).
+                    "held_tasks": [],
                 },
             )
         # graftlint: allow[blocking-propagation] one-time initial membership application before the loop starts

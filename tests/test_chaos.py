@@ -70,10 +70,34 @@ class TestParsePlan:
         with pytest.raises(ChaosError):
             parse_plan(bad)
 
+    def test_kill_target_master(self):
+        """r18: kill:target=master binds to the servicer's report hook
+        and ONLY it — a plan can never kill both process families."""
+        (f,) = parse_plan("kill:target=master,step=3")
+        assert f.target == "master"
+        assert f.matches("master:report", {"step": 3})
+        assert not f.matches("master:report", {"step": 2})
+        assert not f.matches("worker:task", {"step": 3, "rank": 0})
+        # The default target stays the worker boundary.
+        (g,) = parse_plan("kill:rank=0,step=1")
+        assert not g.matches("master:report", {"step": 5})
+        assert g.matches("worker:task", {"step": 1, "rank": 0})
+
+    @pytest.mark.parametrize("bad", [
+        "kill:target=ps,step=1",            # unknown target
+        "kill:target=master,rank=1",        # master has no rank
+        "kill:target=master,worker=w-0",    # ...nor a worker id
+        "stall:target=master,ms=5",         # target is kill-only
+    ])
+    def test_master_target_misuse_fails_loud(self, bad):
+        with pytest.raises(ChaosError):
+            parse_plan(bad)
+
     def test_config_validates_plan(self):
         from elasticdl_tpu.common.config import JobConfig
 
         JobConfig(chaos="kill:rank=0,step=1").validate()
+        JobConfig(chaos="kill:target=master,step=2").validate()
         with pytest.raises(ChaosError):
             JobConfig(chaos="zap:ms=1").validate()
 

@@ -718,6 +718,48 @@ def test_rpc_discipline_clean_twin():
     assert _lint(RPC_CLEAN, [RpcDisciplinePass()]) == []
 
 
+# r18: bare readiness waits — the raw channel_ready_future primitive is a
+# hand-rolled reconnect loop (one hard timeout, no retry accounting, no
+# jitter) and is legal only inside common/rpc.py, whose
+# wait_channel_ready wraps it in the shared backoff helper.
+
+READY_SEEDED = """
+    import grpc
+
+    class Client:
+        def wait_ready(self, timeout_s=10.0):
+            grpc.channel_ready_future(self._channel).result(timeout=timeout_s)
+"""
+
+READY_CLEAN = """
+    from elasticdl_tpu.common.rpc import wait_channel_ready
+
+    class Client:
+        def wait_ready(self, timeout_s=10.0):
+            wait_channel_ready(
+                self._channel, service="x", budget_s=timeout_s
+            )
+"""
+
+
+def test_rpc_discipline_flags_bare_readiness_wait():
+    findings = _lint(READY_SEEDED, [RpcDisciplinePass()])
+    assert _rules(findings) == {"rpc-discipline"}
+    assert "channel_ready_future" in findings[0].message
+
+
+def test_rpc_discipline_readiness_clean_twin():
+    assert _lint(READY_CLEAN, [RpcDisciplinePass()]) == []
+
+
+def test_rpc_discipline_readiness_owner_module_exempt():
+    src = textwrap.dedent(READY_SEEDED)
+    assert lint_text(
+        src, [RpcDisciplinePass()],
+        path="elasticdl_tpu/common/rpc.py",
+    ) == []
+
+
 # ---- thread-hygiene ----
 
 THREAD_SEEDED = """

@@ -105,6 +105,170 @@ class TestPodManager:
         assert JobConfig.from_env(env).job_name == "j"
 
 
+class TestPodReattach:
+    """r18 master crash survivability: the pod registry lets a restarted
+    master ADOPT the previous master's live worker orphans instead of
+    spawning a duplicate fleet, and resolves their unknowable exit codes
+    against job state."""
+
+    @staticmethod
+    def _sleep_backend(log_dir=None):
+        return ProcessPodBackend(
+            argv=[sys.executable, "-c", "import time; time.sleep(60)"],
+            poll_interval_s=0.05,
+        )
+
+    def _config(self, n=1):
+        return JobConfig(job_name="rejob", num_workers=n, max_worker_relaunch=1)
+
+    def test_registry_persists_and_restart_adopts(self, tmp_path):
+        state = str(tmp_path / "pod_registry.json")
+        b1 = self._sleep_backend()
+        m1 = PodManager(b1, self._config(), state_path=state)
+        m1.start(1)
+        pid = b1.pid("rejob-worker-0")
+        assert pid is not None and os.path.exists(state)
+        import json
+
+        reg = json.load(open(state))
+        assert reg["slots"]["0"]["pid"] == pid
+        # "Crash": the first manager/backend go away WITHOUT delete_pod —
+        # only the subprocess handle dies, the process lives on.
+        b1._stop.set()
+        with b1._lock:
+            b1._procs.clear()  # simulate the master process dying
+
+        events = []
+        b2 = self._sleep_backend()
+        m2 = PodManager(b2, self._config(), state_path=state)
+        m2.add_listener(lambda name, phase: events.append((name, phase)))
+        m2.start(1)
+        # Adopted, not respawned: same name, same pid, RUNNING emitted.
+        assert b2.pid("rejob-worker-0") == pid
+        with b2._lock:
+            assert b2._adopted == {"rejob-worker-0": pid}
+            assert not b2._procs  # nothing spawned
+        assert ("rejob-worker-0", PodPhase.RUNNING) in events
+        m2.stop()
+        assert not os.path.exists(state)  # clean stop clears the registry
+        # stop() killed the adopted orphan too (pid_alive is zombie-aware:
+        # in THIS harness the "orphan" is our own unreaped child, a case
+        # production adoption never sees — real orphans reap via init).
+        from elasticdl_tpu.master.pod_manager import pid_alive
+
+        deadline = time.time() + 5
+        while time.time() < deadline and pid_alive(pid):
+            time.sleep(0.05)
+        assert not pid_alive(pid)
+
+    def test_dead_registry_pid_falls_through_to_spawn(self, tmp_path):
+        state = str(tmp_path / "pod_registry.json")
+        import json
+
+        json.dump(
+            {"slots": {"0": {"name": "rejob-worker-0-r2", "pid": 2 ** 22 + 1234,
+                             "relaunches": 2, "gen": 2}}},
+            open(state, "w"),
+        )
+        b = self._sleep_backend()
+        m = PodManager(b, self._config(), state_path=state)
+        m.start(1)
+        with b._lock:
+            assert not b._adopted
+            assert len(b._procs) == 1  # normal spawn
+            # The dead generation's gen still seeds the slot: the fresh
+            # pod must NOT reuse the dead incarnation's exact name (late
+            # events and worker-id collisions would alias to it).
+            (name,) = b._procs
+        assert name == "rejob-worker-0-r3"
+        m.stop()
+
+    def test_lost_resolves_failed_before_finish_succeeded_after(self, tmp_path):
+        import subprocess
+
+        state = str(tmp_path / "pod_registry.json")
+        orphan = subprocess.Popen(
+            [sys.executable, "-c", "import time; time.sleep(60)"]
+        )
+        try:
+            import json
+
+            json.dump(
+                {"slots": {"0": {"name": "rejob-worker-0", "pid": orphan.pid,
+                                 "relaunches": 0, "gen": 0}}},
+                open(state, "w"),
+            )
+            b = self._sleep_backend()
+            m = PodManager(b, self._config(), state_path=state)
+            finished = {"v": False}
+            m.set_job_finished_fn(lambda: finished["v"])
+            events = []
+            m.add_listener(lambda name, phase: events.append((name, phase)))
+            m.start(1)
+            with b._lock:
+                assert b._adopted == {"rejob-worker-0": orphan.pid}
+            # Kill the orphan while the job is NOT finished: LOST resolves
+            # to FAILED and the slot relaunches (budget charged).
+            orphan.kill()
+            orphan.wait()
+            deadline = time.time() + 10
+            while time.time() < deadline and not any(
+                p == PodPhase.FAILED for _n, p in events
+            ):
+                time.sleep(0.05)
+            assert ("rejob-worker-0", PodPhase.FAILED) in events
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                with b._lock:
+                    if b._procs:  # the relaunch spawned
+                        break
+                time.sleep(0.05)
+            info = m.pod_info("rejob-worker-0-r1")
+            assert info is not None and info.relaunches == 1
+            m.stop()
+        finally:
+            if orphan.poll() is None:
+                orphan.kill()
+
+    def test_lost_after_job_end_resolves_succeeded(self, tmp_path):
+        import json
+        import subprocess
+
+        state = str(tmp_path / "pod_registry.json")
+        orphan = subprocess.Popen(
+            [sys.executable, "-c", "import time; time.sleep(60)"]
+        )
+        try:
+            json.dump(
+                {"slots": {"0": {"name": "rejob-worker-0", "pid": orphan.pid,
+                                 "relaunches": 0, "gen": 0}}},
+                open(state, "w"),
+            )
+            b = self._sleep_backend()
+            m = PodManager(b, self._config(), state_path=state)
+            m.set_job_finished_fn(lambda: True)  # the job is already done
+            events = []
+            m.add_listener(lambda name, phase: events.append((name, phase)))
+            m.start(1)
+            orphan.kill()
+            orphan.wait()
+            # A disappearance AFTER the job finished IS the worker's
+            # clean exit: SUCCEEDED, slot retired, no relaunch.
+            deadline = time.time() + 10
+            while time.time() < deadline and (
+                ("rejob-worker-0", PodPhase.SUCCEEDED) not in events
+            ):
+                time.sleep(0.05)
+            assert ("rejob-worker-0", PodPhase.SUCCEEDED) in events
+            assert m.all_finished()
+            with b._lock:
+                assert not b._procs  # nothing relaunched
+            m.stop()
+        finally:
+            if orphan.poll() is None:
+                orphan.kill()
+
+
 class TestPodManifest:
     def test_tpu_pod_manifest_shape(self):
         config = JobConfig(job_name="deepfm")

@@ -373,6 +373,20 @@ def main() -> None:
         "fault path works without the full gang run",
     )
     ap.add_argument(
+        "--masterfail", action="store_true",
+        help="also run the r18 master-kill survivability fleet "
+        "(tools/chaos_bench.py --masterfail) after the training configs; "
+        "it stamps its own MASTERFAIL artifact — journal replay, worker "
+        "ride-through, outage decomposition, exactly-once",
+    )
+    ap.add_argument(
+        "--masterfail-smoke", action="store_true",
+        help="run ONLY the masterfail smoke: 1-worker fleet, the master "
+        "chaos-killed and restarted mid-job — asserts the worker rode "
+        "through WITHOUT relaunch, the journal replayed, and nothing "
+        "trained twice",
+    )
+    ap.add_argument(
         "--collective", action="store_true",
         help="also run the graftreduce bench (tools/collective_bench.py) "
         "after the training configs; it stamps its own COLLECT artifact — "
@@ -406,6 +420,29 @@ def main() -> None:
     args = ap.parse_args()
     if args.gauge_smoke:
         raise SystemExit(run_gauge_smoke())
+    if args.masterfail_smoke:
+        # CPU-harness subprocess fleet, no chip probe (the chaos-smoke
+        # stance): the smoke measures master crash survivability — the
+        # journal replay + ride-through machinery — not the accelerator.
+        from tools.chaos_bench import run_masterfail_smoke
+
+        result = run_masterfail_smoke(
+            lambda m: print(
+                f"[masterfail-smoke] {m}", file=sys.stderr, flush=True
+            )
+        )
+        print(json.dumps(result), flush=True)
+        if result["problems"]:
+            for p in result["problems"]:
+                print(f"[masterfail-smoke] FAIL: {p}", file=sys.stderr)
+            raise SystemExit(1)
+        print(
+            "[masterfail-smoke] PASS: worker rode the master restart out "
+            f"without relaunch, {result['journal'].get('replayed_events')} "
+            "journal event(s) replayed, zero double-train",
+            file=sys.stderr,
+        )
+        return
     if args.chaos_smoke:
         # CPU-harness subprocess fleet, no chip probe: the smoke measures
         # the recovery machinery, not the accelerator.
@@ -515,6 +552,12 @@ def main() -> None:
         # Subprocess-fleet driven (the bench process itself stays
         # jax-free), so running it after the in-process configs is safe.
         chaos_main([])
+    if args.masterfail:
+        from tools.chaos_bench import main as chaos_main
+
+        # Master + workers all run as subprocesses; this process only
+        # watches over gRPC, so it composes with the in-process configs.
+        chaos_main(["--masterfail"])
     if args.collective:
         from tools.collective_bench import main as collective_main
 

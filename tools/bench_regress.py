@@ -127,6 +127,18 @@ EXTRACTORS = {
             .get("recovery", {}).get("recovery_time_ms"), LOWER),
     },
     "ps_pull_push_latency": lambda d: {},  # indexed, not gated (shape varies)
+    # r18 master crash survivability: the kill -> first-post-replay-task
+    # recovery (down), its replay stage (down), and goodput under the
+    # restart (up) — TRAJECTORY gates master restarts from r18 on.
+    "master_kill_survivability": lambda d: {
+        "recovery_ms": (
+            ((d.get("fleets") or {}).get("masterkill") or {})
+            .get("recovery", {}).get("recovery_ms"), LOWER),
+        "journal_replay_ms": (
+            ((d.get("fleets") or {}).get("masterkill") or {})
+            .get("recovery", {}).get("replay_ms"), LOWER),
+        "goodput_under_restart": (d.get("goodput_under_restart"), HIGHER),
+    },
     # graftreduce (r15): step time per sweep point (down), and the
     # in-collective straggler degradation — the subgroup path's in-step
     # wait on phase clocks (the skip-to-recover twin of r13's

@@ -40,7 +40,7 @@ if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
 DEFAULT_PATHS = ("elasticdl_tpu", "tools")
-ARTIFACT_NAME = "LINT_r17.json"
+ARTIFACT_NAME = "LINT_r18.json"
 
 #: jitsan runtime stats (common/jitsan.py dump, GRAFT_JITSAN_DUMP) merged
 #: into the artifact when present: the static tool stays jax-free, so the
