@@ -35,6 +35,7 @@ from __future__ import annotations
 import json
 import socket
 import threading
+import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 
@@ -191,3 +192,95 @@ def maybe_start(
             port,
         )
         return None
+
+
+# ---- scrape client (the OTHER end of the endpoint above) ----------------
+#
+# Moved here from tools/watch_job.py (r19): the serving fleet controller
+# scrapes its replicas' /metrics endpoints as the autoscaling signal, and a
+# framework module cannot import from tools/ — so the fetch/parse pair
+# lives beside the server it reads and watch_job re-imports it.  Still
+# stdlib-only: this file stays legal for the jax-free control plane AND
+# the operator's laptop.
+
+
+def _url(address: str, path: str = "/metrics") -> str:
+    if address.startswith(("http://", "https://")):
+        base = address.rstrip("/")
+        # An explicit path in the URL wins (scraping through a proxy).
+        return base if "/" in base.split("//", 1)[1] else base + path
+    return f"http://{address}{path}"
+
+
+def fetch_text(address: str, path: str = "/metrics",
+               timeout_s: float = 5.0) -> str:
+    with urllib.request.urlopen(_url(address, path), timeout=timeout_s) as r:
+        return r.read().decode()
+
+
+def _parse_labels(body: str) -> Dict[str, str]:
+    """``a="b",c="d"`` -> dict.  The renderer never emits quotes/commas
+    inside values (labels come from worker ids / phase names), so a
+    simple split is exact for our own exposition."""
+    out: Dict[str, str] = {}
+    for part in body.split(","):
+        if "=" in part:
+            k, v = part.split("=", 1)
+            out[k.strip()] = v.strip().strip('"')
+    return out
+
+
+def parse_prometheus(text: str) -> Dict[str, dict]:
+    """Prometheus text -> ``{family: {"type", "help", "samples": [
+    {"name", "labels", "value"}]}}`` — the inverse of
+    ``gauge.render_families`` (histogram ``_bucket``/``_sum``/``_count``
+    series stay flat samples under their family).  Malformed lines are
+    skipped: this parses OUR renderer's output, but a scrape racing a
+    process exit may truncate mid-line."""
+    families: Dict[str, dict] = {}
+
+    def fam(name: str) -> dict:
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if base.endswith(suffix) and base[: -len(suffix)] in families:
+                base = base[: -len(suffix)]
+                break
+        return families.setdefault(
+            base, {"type": "untyped", "help": "", "samples": []}
+        )
+
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            rest = line[len("# HELP "):].split(" ", 1)
+            fam(rest[0])["help"] = rest[1] if len(rest) > 1 else ""
+            continue
+        if line.startswith("# TYPE "):
+            rest = line[len("# TYPE "):].split(" ", 1)
+            fam(rest[0])["type"] = rest[1].strip() if len(rest) > 1 else ""
+            continue
+        if line.startswith("#"):
+            continue
+        try:
+            metric, value_s = line.rsplit(" ", 1)
+            value = float(value_s)
+        except ValueError:
+            continue
+        labels: Dict[str, str] = {}
+        name = metric
+        if "{" in metric and metric.endswith("}"):
+            name, body = metric.split("{", 1)
+            labels = _parse_labels(body[:-1])
+        fam(name)["samples"].append(
+            {"name": name, "labels": labels, "value": value}
+        )
+    return families
+
+
+def fetch(address: str, timeout_s: float = 5.0) -> Dict[str, dict]:
+    """One scrape, parsed — the programmatic entry (benches stamp this as
+    their ``live_metrics`` snapshot; the fleet controller reads its knee
+    signal from it)."""
+    return parse_prometheus(fetch_text(address, timeout_s=timeout_s))

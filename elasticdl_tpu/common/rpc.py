@@ -239,14 +239,27 @@ SERVING_SERVICE_NAME = "elasticdl.Serving"
 SERVING_SCHEMAS: Dict[str, MessageSchema] = {
     # features: {feature_name: nested list}, shaped per the model's feature
     # template (ModelInfo reports it).  A single example may omit the
-    # leading batch dim; multi-example requests carry it.
-    "Predict": MessageSchema(required={"features": _DICT}),
+    # leading batch dim; multi-example requests carry it.  lane (optional,
+    # r19): priority lane — "online" (default, the latency-SLO lane) or
+    # "bulk" (eval scoring; weighted admission, shed first).  Optional so
+    # pre-lane clients keep working unchanged — the r9/r12 stance.
+    "Predict": MessageSchema(
+        required={"features": _DICT}, optional={"lane": _STR}
+    ),
     "ModelInfo": MessageSchema(),
 }
 
 
 class SchemaError(ValueError):
     """A message violated its method's schema (the structured boundary error)."""
+
+
+class RpcOverloaded(RuntimeError):
+    """A handler shed the request: the service is past its capacity knee
+    and refusing work ON PURPOSE.  The generic handler surfaces any
+    subclass as RESOURCE_EXHAUSTED — the structured back-off-or-add-
+    capacity signal callers branch on (e.g. the serving fleet client
+    never retries it) — instead of an unstructured UNKNOWN."""
 
 
 # -- the ONE retry/backoff policy (r18) -------------------------------------
@@ -477,6 +490,8 @@ def make_generic_handler(
                 # RegisterWorker protocol-version check) surface as the same
                 # structured boundary error, not a generic INTERNAL.
                 ctx.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+            except RpcOverloaded as e:
+                ctx.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
 
         return handler
 

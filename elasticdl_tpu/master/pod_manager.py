@@ -192,7 +192,12 @@ class FakePodBackend(PodBackend):
 
 class ProcessPodBackend(PodBackend):
     """Worker pods as local subprocesses; a watcher thread maps exit codes to
-    pod events.  ``argv`` defaults to the worker main module.
+    pod events.  ``argv`` defaults to the worker main module; the serving
+    fleet controller (serving/fleet.py, r19) runs the SAME backend with
+    ``argv=[..., "-m", "elasticdl_tpu.serving.main"]`` — replicas speak the
+    identical standby/adoption env contract (ELASTICDL_WORKER_ID/SLOT +
+    go-file), so spawn, warm standby, crash relaunch and the r18 reattach
+    registry all carry over to serving without a parallel implementation.
 
     ``warm_standby=True`` keeps a small POOL of pre-booted spares parked:
     processes that have already paid python + jax + framework imports

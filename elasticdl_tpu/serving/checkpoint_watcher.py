@@ -12,9 +12,13 @@ can never hand the server a half-written checkpoint.
 The callback runs on the watcher thread; the server's reload
 (serving/server.py) does the expensive restore there, CONCURRENT with
 serving, and only the final reference swap touches the live path.  A
-failing callback is logged and retried at the poll cadence — a torn
-volume or transient read error must not kill the watcher (the next
-publish, or the next poll, gets another chance).
+failing callback must not kill the watcher.  TRANSIENT failures (OSError:
+a torn volume, an NFS hiccup mid-restore) retry immediately through the
+shared backoff helper (``common/rpc.call_with_backoff`` — r18's one retry
+code path, never a hand-rolled loop), because a reload deferred a whole
+poll interval is a whole poll interval of stale weights; anything else
+(a genuinely corrupt checkpoint) is logged and waits for the next poll
+or the next publish — hammering it would fail identically.
 """
 
 from __future__ import annotations
@@ -25,8 +29,16 @@ from typing import Any, Callable, Dict, Optional
 from elasticdl_tpu.common import racesan, trace
 from elasticdl_tpu.common.checkpoint import read_manifest
 from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.common.rpc import BackoffPolicy, call_with_backoff
 
 logger = get_logger("serving.ckpt_watcher")
+
+#: Retry shape for a transiently failing reload: a few fast attempts, then
+#: give up until the poll cadence — the volume either heals in milliseconds
+#: or the next poll (with fresh manifest state) is the right re-entry.
+RELOAD_RETRY_POLICY = BackoffPolicy(
+    base_s=0.05, multiplier=2.0, max_s=0.5, jitter=0.2, max_attempts=3
+)
 
 
 # racesan (r16): _applied is single-writer (the watcher thread); the
@@ -80,11 +92,17 @@ class CheckpointWatcher:
             return False
         step = int(m["step"])
         try:
-            self._on_new_step(step, m)
+            call_with_backoff(
+                lambda: self._on_new_step(step, m),
+                service="serving.ckpt_watcher",
+                is_transient=lambda e: isinstance(e, OSError),
+                policy=RELOAD_RETRY_POLICY,
+            )
         except Exception:
             logger.exception(
-                "hot reload to step %d failed; retrying at the poll cadence",
-                step,
+                "hot reload to step %d failed (transient attempts "
+                "exhausted, or a non-transient error); retrying at the "
+                "poll cadence", step,
             )
             return False
         self._applied = step

@@ -17,10 +17,24 @@ Flush policy — deadline-or-full:
   flush whatever is queued; under light load a lone request pays at most
   the deadline plus one forward, never an unbounded wait for company.
 
-Every flush pads to exactly ``max_batch`` rows (zero rows, ``__mask__``
-marking the real ones) so the jitted forward compiles ONCE — a varying
-batch dimension would recompile per distinct size, and XLA compiles are
-milliseconds-to-seconds, i.e. death on a latency SLO.
+Padding is BUCKETED (r19): each flush zero-pads to the smallest declared
+``batch_buckets`` size that holds its real rows (``__mask__`` marking the
+real ones), so the jitted forward compiles once PER BUCKET — a bounded,
+budget-declared set of shapes (serving/server.py registers the bucket count
+as the jitsan ``expected_variants`` budget) instead of either extreme:
+padding every deadline flush to ``max_batch`` (SERVE_r10 measured 94% of
+flushed rows as padding) or recompiling per arbitrary batch size (XLA
+compiles are milliseconds-to-seconds, i.e. death on a latency SLO).
+
+Requests ride in PRIORITY LANES (r19): ``online`` (the latency-SLO traffic)
+and ``bulk`` (eval scoring, backfills).  Admission is weighted — a flush
+takes online requests first and reserves at most a ``bulk_weight`` fraction
+of the batch for bulk when both lanes are queued, so bulk saturation cannot
+starve online p99s while bulk still drains at a guaranteed trickle.
+Overload sheds bulk FIRST: the bulk lane's queue share is bounded at
+``bulk_queue_frac`` of the row bound, and an online submit that finds the
+queue full evicts the newest queued bulk requests before it would ever shed
+itself.  Every shed/expiry is attributed to its lane in ``stats()``.
 
 The runner executes in the flusher thread and is HANDED the current model
 snapshot by the server (serving/server.py) — requests in flight during a
@@ -32,40 +46,49 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from elasticdl_tpu.common import locksan, trace
 from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.common.rpc import RpcOverloaded
 
 logger = get_logger("serving.micro_batcher")
 
 MASK_KEY = "__mask__"
+
+#: Priority lanes, highest priority first.  ``online`` is the latency-SLO
+#: lane; ``bulk`` is throughput traffic that is admitted at a bounded
+#: weight and shed first under overload.
+LANES = ("online", "bulk")
+DEFAULT_LANE = "online"
 
 
 class BatcherClosed(RuntimeError):
     """submit() after close(): the server is shutting down."""
 
 
-class BatcherOverloaded(RuntimeError):
+class BatcherOverloaded(RpcOverloaded):
     """submit() with the queue at its row bound: the replica is past its
-    knee — shed THIS request now (the caller sees a fast structured error)
-    instead of queueing it into a wait it cannot survive."""
+    knee — shed THIS request now (the caller sees a fast structured
+    RESOURCE_EXHAUSTED, via the RpcOverloaded mapping at the generic
+    handler) instead of queueing it into a wait it cannot survive."""
 
 
 class PredictionHandle:
     """One request's slot in a future flush: the handler thread parks on
     ``result()`` until the flusher fans the outputs back."""
 
-    __slots__ = ("count", "features", "arrival", "_event", "_outputs",
-                 "_meta", "_error")
+    __slots__ = ("count", "features", "arrival", "lane", "_event",
+                 "_outputs", "_meta", "_error")
 
     def __init__(self, count: int, features: Dict[str, np.ndarray],
-                 arrival: float):
+                 arrival: float, lane: str = DEFAULT_LANE):
         self.count = count
         self.features = features
         self.arrival = arrival
+        self.lane = lane
         self._event = threading.Event()
         self._outputs: Any = None
         self._meta: Dict[str, Any] = {}
@@ -102,16 +125,33 @@ def _slice_outputs(outputs: Any, lo: int, hi: int) -> Any:
     return np.asarray(outputs)[lo:hi]
 
 
+class _LaneState:
+    """One priority lane's queue + attribution counters (guarded-by the
+    batcher's _cond, like every other piece of queue state)."""
+
+    __slots__ = ("queue", "queued_rows", "submitted", "shed", "expired",
+                 "rows_served")
+
+    def __init__(self) -> None:
+        self.queue: List[PredictionHandle] = []
+        self.queued_rows = 0
+        self.submitted = 0
+        self.shed = 0
+        self.expired = 0
+        self.rows_served = 0
+
+
 class MicroBatcher:
     """Deadline-or-full request coalescing in front of a batch runner.
 
     ``runner(batch, n_real) -> (outputs, meta)``: ``batch`` is a dict of
-    numpy arrays padded to ``max_batch`` rows (plus ``__mask__`` f32
-    [max_batch], 1.0 on real rows); outputs must keep the leading example
-    dim; ``meta`` is attached to every request of the flush (the server
-    stamps the serving model step).  Runs on the flusher thread — blocking
-    there is the design (it IS the accounted inference), which is why the
-    runner is not a ``# hot-path`` function but ``submit`` is.
+    numpy arrays padded to one of the ``batch_buckets`` row counts (plus
+    ``__mask__`` f32 [bucket], 1.0 on real rows); outputs must keep the
+    leading example dim; ``meta`` is attached to every request of the flush
+    (the server stamps the serving model step).  Runs on the flusher
+    thread — blocking there is the design (it IS the accounted inference),
+    which is why the runner is not a ``# hot-path`` function but ``submit``
+    is.
     """
 
     def __init__(
@@ -123,6 +163,9 @@ class MicroBatcher:
         name: str = "serving",
         max_queue_rows: Optional[int] = None,
         drop_after_s: float = 30.0,
+        batch_buckets: Optional[Sequence[int]] = None,
+        bulk_weight: float = 0.25,
+        bulk_queue_frac: float = 0.5,
     ):
         """Overload policy (sustained load past the replica's knee):
 
@@ -135,14 +178,44 @@ class MicroBatcher:
           time fails with TimeoutError instead of occupying flush slots —
           its handler already gave up, and running a padded forward for
           nobody would deepen the very backlog that expired it.
+
+        Shape policy:
+
+        - ``batch_buckets`` (default ``(max_batch,)``): the padded batch
+          sizes this batcher emits.  Each flush pads to the smallest bucket
+          holding its real rows; ``max_batch`` is always a bucket so a full
+          flush stays legal.  The server declares ``len(batch_buckets)`` as
+          the predict step's jitsan variant budget.
+
+        Lane policy:
+
+        - ``bulk_weight``: fraction of a flush reserved for the bulk lane
+          while BOTH lanes are queued (weighted admission — bulk cannot
+          starve, online keeps the rest).  0.0 = strict priority.
+        - ``bulk_queue_frac``: the bulk lane's share of ``max_queue_rows``;
+          bulk sheds at this bound (and at the total bound) so a bulk flood
+          can never consume the queue capacity online admission relies on.
         """
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if not 0.0 <= bulk_weight < 1.0:
+            raise ValueError(f"bulk_weight must be in [0, 1), got {bulk_weight}")
+        if not 0.0 < bulk_queue_frac <= 1.0:
+            raise ValueError(
+                f"bulk_queue_frac must be in (0, 1], got {bulk_queue_frac}"
+            )
+        buckets = sorted(set(int(b) for b in (batch_buckets or ())) | {max_batch})
+        if buckets[0] < 1 or buckets[-1] > max_batch:
+            raise ValueError(
+                f"batch_buckets must lie in 1..max_batch={max_batch}, "
+                f"got {buckets}"
+            )
+        self.batch_buckets: Tuple[int, ...] = tuple(buckets)
         self._runner = runner
-        # Per-feature zero rows at the padded batch shape: built once, so a
-        # flush only copies request rows in (no per-flush allocation of the
-        # template itself — padded buffers are fresh per flush, the model
-        # may donate them).
+        # Per-feature zero rows at the padded batch shape: built once at
+        # max_batch (the largest bucket); a smaller-bucket flush slices the
+        # leading rows off these, so a flush only copies request rows in
+        # (padded buffers are fresh per flush, the model may donate them).
         self._template = {
             k: np.zeros((max_batch,) + tuple(np.asarray(v).shape[1:]),
                         np.asarray(v).dtype)
@@ -153,21 +226,20 @@ class MicroBatcher:
         self.max_queue_rows = (
             max_queue_rows if max_queue_rows is not None else 32 * max_batch
         )
+        self.bulk_weight = bulk_weight
+        self.bulk_max_rows = max(1, int(self.max_queue_rows * bulk_queue_frac))
         self.drop_after_s = drop_after_s
         self._lock = locksan.lock("MicroBatcher._lock", leaf=True)  # lock-order: leaf
         self._cond = threading.Condition(self._lock)
-        self._queue: List[PredictionHandle] = []  # guarded-by: _cond
-        self._queued_rows = 0  # guarded-by: _cond
+        self._lanes: Dict[str, _LaneState] = {ln: _LaneState() for ln in LANES}  # guarded-by: _cond
         self._closed = False  # guarded-by: _cond
         # Counters (stats()): mutated only under the condition lock.
-        self._submitted = 0  # guarded-by: _cond
         self._flushes_full = 0  # guarded-by: _cond
         self._flushes_deadline = 0  # guarded-by: _cond
         self._flushes_close = 0  # guarded-by: _cond
         self._rows_served = 0  # guarded-by: _cond
         self._rows_padded = 0  # guarded-by: _cond
-        self._shed = 0  # guarded-by: _cond
-        self._expired = 0  # guarded-by: _cond
+        self._flushes_by_bucket: Dict[int, int] = {b: 0 for b in self.batch_buckets}  # guarded-by: _cond
         self._thread = threading.Thread(
             target=self._flush_loop, name=f"edl-serve-flush:{name}", daemon=True
         )
@@ -175,14 +247,22 @@ class MicroBatcher:
 
     # -- request side --
 
+    def _queued_rows_locked(self) -> int:  # guarded-by: _cond
+        return sum(ln.queued_rows for ln in self._lanes.values())
+
     # hot-path: the per-request enqueue on the serving critical path — one
     # lock hand-off and a notify, never a device touch or an RPC
-    def submit(self, features: Dict[str, np.ndarray]) -> PredictionHandle:
+    def submit(
+        self, features: Dict[str, np.ndarray], lane: str = DEFAULT_LANE
+    ) -> PredictionHandle:
         """Queue ``features`` (dict of [n, ...] arrays covering the template
-        keys, consistent leading dim 1 <= n <= max_batch) for the next
-        flush.  Validation is exhaustive HERE, in the offender's own stack
-        frame: a malformed request that only failed during batch assembly
-        would fan its error to every innocent request co-batched with it."""
+        keys, consistent leading dim 1 <= n <= max_batch) on priority
+        ``lane`` for a future flush.  Validation is exhaustive HERE, in the
+        offender's own stack frame: a malformed request that only failed
+        during batch assembly would fan its error to every innocent request
+        co-batched with it."""
+        if lane not in LANES:  # the lane SET is a module constant; _lanes stays behind _cond
+            raise ValueError(f"unknown priority lane {lane!r}; expected {LANES}")
         missing = [k for k in self._template if k not in features]
         if missing:
             raise ValueError(f"request missing feature(s) {missing}")
@@ -208,48 +288,97 @@ class MicroBatcher:
                 f"request carries {n} examples; must be 1..{self.max_batch} "
                 "(split larger requests client-side)"
             )
-        handle = PredictionHandle(n, arrays, time.monotonic())
+        handle = PredictionHandle(n, arrays, time.monotonic(), lane)
         with self._cond:
             if self._closed:
                 raise BatcherClosed("micro-batcher is closed")
-            if self._queued_rows + n > self.max_queue_rows:
-                self._shed += 1
+            st = self._lanes[lane]
+            bulk = self._lanes["bulk"]
+            if lane == "bulk" and bulk.queued_rows + n > self.bulk_max_rows:
+                st.shed += 1
                 raise BatcherOverloaded(
-                    f"queue holds {self._queued_rows} rows (bound "
-                    f"{self.max_queue_rows}); shedding — the replica is "
-                    "past its knee, add replicas or lower the offered load"
+                    f"bulk lane holds {bulk.queued_rows} rows (lane bound "
+                    f"{self.bulk_max_rows}); shedding bulk — the online lane "
+                    "keeps the remaining queue capacity"
                 )
-            self._queue.append(handle)
-            self._queued_rows += n
-            self._submitted += 1
+            if self._queued_rows_locked() + n > self.max_queue_rows:
+                if lane == "online":
+                    # Shed bulk first: evict the NEWEST queued bulk requests
+                    # (they have waited least) until this online request
+                    # fits.  The evicted callers see the same structured
+                    # BatcherOverloaded a front-door shed produces.
+                    while (bulk.queue
+                           and self._queued_rows_locked() + n > self.max_queue_rows):
+                        evicted = bulk.queue.pop()
+                        bulk.queued_rows -= evicted.count
+                        bulk.shed += 1
+                        evicted._fail(BatcherOverloaded(
+                            "bulk request evicted from the serving queue to "
+                            "admit online traffic (shed-bulk-first overload "
+                            "policy)"
+                        ))
+                if self._queued_rows_locked() + n > self.max_queue_rows:
+                    st.shed += 1
+                    raise BatcherOverloaded(
+                        f"queue holds {self._queued_rows_locked()} rows (bound "
+                        f"{self.max_queue_rows}); shedding — the replica is "
+                        "past its knee, add replicas or lower the offered load"
+                    )
+            st.queue.append(handle)
+            st.queued_rows += n
+            st.submitted += 1
             self._cond.notify()
         return handle
 
     # -- flusher side --
 
+    def _expire_locked(self, now: float) -> None:  # guarded-by: _cond
+        """Shed expired requests (queued longer than drop_after_s — their
+        handlers have already timed out): running a forward for nobody
+        would deepen the backlog that expired them.  Arrival-ordered per
+        lane, so each lane's expired set is a prefix."""
+        for st in self._lanes.values():
+            while st.queue and now - st.queue[0].arrival > self.drop_after_s:
+                h = st.queue.pop(0)
+                st.queued_rows -= h.count
+                st.expired += 1
+                h._fail(TimeoutError(
+                    f"request expired after {self.drop_after_s}s in the "
+                    "serving queue (replica overloaded)"
+                ))
+
     def _take_locked(self) -> Tuple[List[PredictionHandle], str]:  # guarded-by: _cond
         """(requests to flush now, reason) or ([], "") to keep waiting.
         Whole requests only — a request never splits across flushes, so its
-        outputs fan back from exactly one runner call."""
-        # Shed expired requests (queued longer than drop_after_s — their
-        # handlers have already timed out): running a forward for nobody
-        # would deepen the backlog that expired them.  Arrival-ordered, so
-        # the expired set is a prefix.
-        now = time.monotonic()
-        while self._queue and now - self._queue[0].arrival > self.drop_after_s:
-            h = self._queue.pop(0)
-            self._queued_rows -= h.count
-            self._expired += 1
-            h._fail(TimeoutError(
-                f"request expired after {self.drop_after_s}s in the serving "
-                "queue (replica overloaded)"
-            ))
-        if not self._queue:
+        outputs fan back from exactly one runner call.
+
+        Weighted admission: online packs first, but while BOTH lanes are
+        queued at most ``1 - bulk_weight`` of the batch goes to online so
+        bulk drains at a guaranteed trickle; bulk then fills whatever rows
+        remain.  An overflow in either lane flushes immediately ("full") —
+        the leftover requests lead the very next flush, so the online cap
+        delays online rows by one flush at most, never stalls them."""
+        self._expire_locked(time.monotonic())
+        online, bulk = self._lanes["online"], self._lanes["bulk"]
+        if not online.queue and not bulk.queue:
             return [], ""
+        cap_online = self.max_batch
+        if bulk.queue and online.queue:
+            cap_online = max(1, self.max_batch - int(self.max_batch * self.bulk_weight))
         take: List[PredictionHandle] = []
         rows = 0
         overflow = False
-        for h in self._queue:
+        for i, h in enumerate(online.queue):
+            # The weighted cap never blocks the HEAD online request: a
+            # request wider than the cap would otherwise starve behind a
+            # standing bulk queue (bulk just trickles less that flush).
+            limit = self.max_batch if i == 0 else cap_online
+            if rows + h.count > limit:
+                overflow = True
+                break
+            take.append(h)
+            rows += h.count
+        for h in bulk.queue:
             if rows + h.count > self.max_batch:
                 overflow = True
                 break
@@ -259,7 +388,9 @@ class MicroBatcher:
             return take, "full"
         if self._closed:
             return take, "close"
-        oldest = self._queue[0].arrival
+        oldest = min(
+            q[0].arrival for q in (online.queue, bulk.queue) if q
+        )
         if time.monotonic() - oldest >= self.max_delay_s:
             return take, "deadline"
         return [], ""
@@ -269,21 +400,27 @@ class MicroBatcher:
             with self._cond:
                 take, reason = self._take_locked()
                 while not take:
-                    if self._closed and not self._queue:
+                    queues = [st.queue for st in self._lanes.values() if st.queue]
+                    if self._closed and not queues:
                         return
-                    if self._queue:
+                    if queues:
                         # Sleep exactly to the oldest request's deadline.
                         remaining = (
-                            self._queue[0].arrival + self.max_delay_s
-                            - time.monotonic()
+                            min(q[0].arrival for q in queues)
+                            + self.max_delay_s - time.monotonic()
                         )
                         self._cond.wait(max(remaining, 0.0))
                     else:
                         self._cond.wait()
                     take, reason = self._take_locked()
-                del self._queue[: len(take)]
-                n_real = sum(h.count for h in take)
-                self._queued_rows -= n_real
+                n_real = 0
+                for h in take:
+                    st = self._lanes[h.lane]
+                    st.queue.remove(h)
+                    st.queued_rows -= h.count
+                    st.rows_served += h.count
+                    n_real += h.count
+                bucket = next(b for b in self.batch_buckets if b >= n_real)
                 if reason == "full":
                     self._flushes_full += 1
                 elif reason == "deadline":
@@ -291,23 +428,30 @@ class MicroBatcher:
                 else:
                     self._flushes_close += 1
                 self._rows_served += n_real
-                self._rows_padded += self.max_batch - n_real
-            self._run_flush(take, n_real)
+                self._rows_padded += bucket - n_real
+                self._flushes_by_bucket[bucket] += 1
+            self._run_flush(take, n_real, bucket)
 
-    def _run_flush(self, take: List[PredictionHandle], n_real: int) -> None:
-        """Assemble the padded batch, run it, fan outputs back.  Runner
-        failures resolve every request of THIS flush with the error and the
-        flusher survives — one poisoned batch must not wedge the server."""
+    def _run_flush(
+        self, take: List[PredictionHandle], n_real: int, bucket: int
+    ) -> None:
+        """Assemble the bucket-padded batch, run it, fan outputs back.
+        Runner failures resolve every request of THIS flush with the error
+        and the flusher survives — one poisoned batch must not wedge the
+        server."""
         try:
             # The flush span IS the serving tier's unit of work: request
-            # count + real/padded rows beside its wall make batching
-            # efficiency (and the padding tax) visible in the merged trace.
+            # count + real/padded rows + the chosen bucket beside its wall
+            # make batching efficiency (and the padding tax) visible in the
+            # merged trace.
             with trace.span(
                 "serving:flush", cat="serving", n_requests=len(take),
-                n_real=n_real, n_padded=self.max_batch - n_real,
+                n_real=n_real, n_padded=bucket - n_real, bucket=bucket,
             ):
-                batch = {k: t.copy() for k, t in self._template.items()}
-                mask = np.zeros((self.max_batch,), np.float32)
+                batch = {
+                    k: t[:bucket].copy() for k, t in self._template.items()
+                }
+                mask = np.zeros((bucket,), np.float32)
                 mask[:n_real] = 1.0
                 batch[MASK_KEY] = mask
                 lo = 0
@@ -330,18 +474,39 @@ class MicroBatcher:
 
     # -- lifecycle / observability --
 
-    def stats(self) -> Dict[str, int]:
+    def stats(self) -> Dict[str, Any]:
+        """Counters since construction.  Top-level keys are lane-summed
+        totals (the pre-lane surface, kept stable for dashboards and the
+        bench); ``lanes`` attributes submission/shed/expiry/service to each
+        priority lane and ``flushes_by_bucket`` counts flushes per padded
+        batch size (JSON-string keys — the stats dict travels in ModelInfo
+        responses and stamped artifacts)."""
         with self._cond:
+            lanes = {
+                name: {
+                    "submitted": st.submitted,
+                    "queued": len(st.queue),
+                    "queued_rows": st.queued_rows,
+                    "shed": st.shed,
+                    "expired": st.expired,
+                    "rows_served": st.rows_served,
+                }
+                for name, st in self._lanes.items()
+            }
             return {
-                "submitted": self._submitted,
-                "queued": len(self._queue),
+                "submitted": sum(s["submitted"] for s in lanes.values()),
+                "queued": sum(s["queued"] for s in lanes.values()),
                 "flushes_full": self._flushes_full,
                 "flushes_deadline": self._flushes_deadline,
                 "flushes_close": self._flushes_close,
                 "rows_served": self._rows_served,
                 "rows_padded": self._rows_padded,
-                "shed_overload": self._shed,
-                "expired": self._expired,
+                "shed_overload": sum(s["shed"] for s in lanes.values()),
+                "expired": sum(s["expired"] for s in lanes.values()),
+                "lanes": lanes,
+                "flushes_by_bucket": {
+                    str(b): n for b, n in self._flushes_by_bucket.items()
+                },
             }
 
     def close(self, timeout_s: float = 10.0) -> None:

@@ -6,14 +6,16 @@ ROADMAP item 4: everything before r10 was training-side; this server is the
 
 - **Forward**: the trainer's own jitted predict step
   (``parallel/trainer.build_predict_step``) over a serving mesh — one
-  compiled program at ONE fixed padded batch shape (the micro-batcher
-  guarantees it), using the model's ``predict`` inference entry
+  compiled program per declared batch BUCKET (the micro-batcher pads every
+  flush to a bucket shape, and jitsan budgets exactly that many variants),
+  using the model's ``predict`` inference entry
   (models/spec.ModelSpec.predict) so clients get probabilities, not
   training logits.
 - **Micro-batching**: serving/micro_batcher.MicroBatcher —
-  deadline-or-full flush, zero-padded to ``max_batch``, per-request
-  fan-back.  The r9 amortization trick (many small requests, one hot-path
-  crossing) applied to inference.
+  deadline-or-full flush, zero-padded to the smallest ``batch_buckets``
+  size that fits, priority lanes (online vs bulk, weighted admission,
+  shed-bulk-first), per-request fan-back.  The r9 amortization trick
+  (many small requests, one hot-path crossing) applied to inference.
 - **Sparse features**: host-tier tables pull through
   serving/embedding_cache.HotIdEmbeddingCache layered in front of the PS
   host store (``ps/host_store.py`` locally, ``ps/service.py`` for a PS
@@ -38,7 +40,7 @@ from __future__ import annotations
 
 import time
 from concurrent import futures
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 import grpc
 import numpy as np
@@ -55,7 +57,12 @@ from elasticdl_tpu.common.rpc import (
 )
 from elasticdl_tpu.serving.checkpoint_watcher import CheckpointWatcher
 from elasticdl_tpu.serving.embedding_cache import HotIdEmbeddingCache
-from elasticdl_tpu.serving.micro_batcher import MASK_KEY, MicroBatcher
+from elasticdl_tpu.serving.micro_batcher import (
+    DEFAULT_LANE,
+    LANES,
+    MASK_KEY,
+    MicroBatcher,
+)
 
 logger = get_logger("serving.server")
 
@@ -108,6 +115,9 @@ class ServingServer:
         gauges: Optional[gaugelib.Registry] = None,
         gauge_port: int = -1,
         target_p99_ms: float = 100.0,
+        batch_buckets: Optional[Sequence[int]] = None,
+        bulk_weight: float = 0.25,
+        max_queue_rows: Optional[int] = None,
     ):
         import jax
 
@@ -133,12 +143,16 @@ class ServingServer:
         # Mesh-sharded-table models still restore fine: the padded table
         # shapes are mesh-size-invariant (trainer.pad_embedding_tables).
         self.trainer = Trainer(spec, config, create_mesh([jax.devices()[0]]))
-        # jitsan (v6): the padded-shape buckets this replica serves — ONE
-        # today (every flush zero-pads to max_batch); the batch-size-
-        # bucketed compiles of ROADMAP item 3 extend this tuple, and the
-        # declared budget follows it, so an accidental extra compile (a
-        # shape leaking past the batcher's padding) still fails loud.
-        self._shape_buckets = (max_batch,)
+        # jitsan (v6, bucketed r19): the padded-shape buckets this replica
+        # serves.  Each flush zero-pads to the smallest bucket that holds
+        # its real rows (micro_batcher), the jitted predict step retraces
+        # once per bucket, and the declared budget IS the bucket count — so
+        # an accidental extra compile (a shape leaking past the batcher's
+        # padding) still fails loud, while intended buckets never trip the
+        # retrace sanitizer.
+        self._shape_buckets = tuple(
+            sorted(set(int(b) for b in (batch_buckets or ())) | {max_batch})
+        )
         self.trainer.jit_budgets["predict_step"] = len(self._shape_buckets)
         # Hot-id cache in front of every host-tier store (no-op for models
         # without host tables).
@@ -221,6 +235,15 @@ class ServingServer:
             max_batch=max_batch,
             max_delay_ms=max_delay_ms,
             name=spec.name,
+            batch_buckets=self._shape_buckets,
+            bulk_weight=bulk_weight,
+            # The batcher's bounded queue must be THE queue: size the gRPC
+            # handler pool (max_workers) at or above the expected in-flight
+            # request count, or excess load parks invisibly in the
+            # executor — unmeasured by the latency histogram and unshed by
+            # the admission bounds, which blinds the fleet autoscaler's
+            # two pressure signals.
+            max_queue_rows=max_queue_rows,
         )
 
         # graftgauge (r14): the replica's live metrics — request counter +
@@ -235,11 +258,27 @@ class ServingServer:
         self._g_requests = self.gauges.counter(
             "edl_serving_requests_total", "Predict requests answered"
         )
-        self._g_request_ms = self.gauges.histogram(
-            "edl_serving_request_ms",
-            "per-request wall inside the Predict handler (parse + queue + "
-            "flush + fan-back)",
-        )
+        # Per-lane latency histograms: the SLO (p99 / slo_ratio gauges, and
+        # the fleet autoscaler's windowed-p99 signal) is defined over the
+        # ONLINE lane only — bulk latency is throughput traffic and must
+        # not pollute the knee signal that adds replicas.
+        self._g_request_ms = {
+            lane: self.gauges.histogram(
+                "edl_serving_request_ms",
+                "per-request wall inside the Predict handler (parse + "
+                "queue + flush + fan-back), by priority lane",
+                labels={"lane": lane},
+            )
+            for lane in LANES
+        }
+        self._g_lane_requests = {
+            lane: self.gauges.counter(
+                "edl_serving_lane_requests_total",
+                "Predict requests answered, by priority lane",
+                labels={"lane": lane},
+            )
+            for lane in LANES
+        }
         self.gauges.add_collector(self._collect_gauges)
         self._gauge_port = gauge_port
         self._metrics_server = None
@@ -265,13 +304,20 @@ class ServingServer:
     # ---- model lifecycle ----
 
     def warmup(self) -> float:
-        """Compile the forward at the serving batch shape (one padded zero
-        batch through the real path) so the FIRST request pays RPC + forward,
-        not RPC + XLA compile.  Returns the warmup wall seconds."""
+        """Compile the forward at EVERY serving batch bucket (one padded
+        zero batch per bucket through the real path) so the first request
+        of any bucket pays RPC + forward, not RPC + XLA compile — and so
+        the full jitsan variant budget is spent here, loudly, rather than
+        one retrace at a time under live traffic.  Returns the total
+        warmup wall seconds."""
         t0 = time.perf_counter()
-        batch = {k: np.zeros_like(t) for k, t in self._batcher._template.items()}
-        batch[MASK_KEY] = np.zeros((self.max_batch,), np.float32)
-        self._run_batch(batch, 0)
+        for bucket in self._shape_buckets:
+            batch = {
+                k: np.zeros((bucket,) + t.shape[1:], t.dtype)
+                for k, t in self._batcher._template.items()
+            }
+            batch[MASK_KEY] = np.zeros((bucket,), np.float32)
+            self._run_batch(batch, 0)
         return time.perf_counter() - t0
 
     def _reload(self, step: int, manifest: Dict[str, Any]) -> None:
@@ -350,13 +396,20 @@ class ServingServer:
     # flush fan-back; never a device touch (the flusher owns the forward)
     def _predict(self, req: Dict[str, Any]) -> Dict[str, Any]:
         t0 = time.perf_counter()
+        lane = req.get("lane", DEFAULT_LANE)
+        if lane not in LANES:
+            raise SchemaError(
+                f"Predict: unknown priority lane {lane!r}; expected one "
+                f"of {list(LANES)}"
+            )
         features = self._parse_features(req["features"])
-        handle = self._batcher.submit(features)
+        handle = self._batcher.submit(features, lane=lane)
         outputs, meta = handle.result(timeout_s=30.0)
         with self._state_lock:
             self._requests += 1
         self._g_requests.inc()
-        self._g_request_ms.observe((time.perf_counter() - t0) * 1e3)
+        self._g_lane_requests[lane].inc()
+        self._g_request_ms[lane].observe((time.perf_counter() - t0) * 1e3)
         return {
             "outputs": _listify(outputs),
             "model": self.spec.name,
@@ -389,6 +442,31 @@ class ServingServer:
                 "queue-row bound").set(float(stats["shed_overload"]))
         g.gauge("edl_serving_expired", "requests expired at flush time"
                 ).set(float(stats["expired"]))
+        # Per-lane shed/expiry attribution (r19 satellite): the autoscaler
+        # and the SLO dashboard must tell bulk shed (by design under the
+        # shed-bulk-first policy) from online shed (a capacity red alert).
+        for lane, ls in stats["lanes"].items():
+            g.counter(
+                "edl_serving_shed_total",
+                "requests shed at admission or evicted, by priority lane",
+                labels={"lane": lane},
+            ).set_total(float(ls["shed"]))
+            g.counter(
+                "edl_serving_expired_total",
+                "requests expired at flush time, by priority lane",
+                labels={"lane": lane},
+            ).set_total(float(ls["expired"]))
+            g.gauge(
+                "edl_serving_lane_queued_rows",
+                "rows parked in the micro-batcher, by priority lane",
+                labels={"lane": lane},
+            ).set(float(ls["queued_rows"]))
+        for bucket, n in stats["flushes_by_bucket"].items():
+            g.counter(
+                "edl_serving_bucket_flushes_total",
+                "flushes per padded batch bucket (bucketed compiles)",
+                labels={"bucket": bucket},
+            ).set_total(float(n))
         served = stats["rows_served"]
         g.gauge(
             "edl_serving_batch_fill_ratio",
@@ -413,11 +491,13 @@ class ServingServer:
         g.gauge("edl_serving_reloads", "hot reloads performed").set(
             float(reloads)
         )
-        p99 = self._g_request_ms.quantile(0.99)
+        # The SLO gauges track the ONLINE lane: bulk is throughput traffic
+        # whose latency is not what the autoscaler protects.
+        p99 = self._g_request_ms["online"].quantile(0.99)
         if p99 is not None:
             g.gauge(
                 "edl_serving_p99_ms",
-                "live request p99 (bucket-grid estimate)",
+                "live online-lane request p99 (bucket-grid estimate)",
             ).set(p99)
             g.gauge(
                 "edl_serving_p99_target_ms", "operator SLO target"
@@ -440,6 +520,7 @@ class ServingServer:
             "step": step,
             "max_batch": self.max_batch,
             "max_delay_ms": self.max_delay_ms,
+            "batch_buckets": list(self._shape_buckets),
             "features": {
                 k: {"dtype": str(v.dtype), "example_shape": list(v.shape[1:])}
                 for k, v in self._features.items()

@@ -191,6 +191,210 @@ def test_micro_batcher_sheds_on_overload_and_expires_stale_requests():
         mb.close()
 
 
+# ------------------------------------- buckets + priority lanes (r19)
+
+
+def test_micro_batcher_bucketed_padding_picks_smallest_bucket():
+    """Each flush pads to the smallest declared bucket that holds its real
+    rows — not to max_batch (the r10 design paid 94% padding there) and
+    not to the exact size (which would retrace XLA per arbitrary n)."""
+    calls = []
+    mb = MicroBatcher(_echo_runner(calls), TMPL, max_batch=8, max_delay_ms=5,
+                      batch_buckets=(1, 2, 4))
+    try:
+        assert mb.batch_buckets == (1, 2, 4, 8)  # max_batch always a bucket
+        out1, _ = mb.submit({"x": np.ones((1, 2), np.float32)}).result(5.0)
+        out3, _ = mb.submit({"x": np.ones((3, 2), np.float32)}).result(5.0)
+        assert out1.shape == (1, 2) and out3.shape == (3, 2)
+        # 1 real row -> bucket 1 (zero padding); 3 -> bucket 4 (1 pad row).
+        assert [b["x"].shape[0] for b, _ in calls] == [1, 4]
+        assert list(calls[1][0][MASK_KEY]) == [1, 1, 1, 0]
+        st = mb.stats()
+        assert st["flushes_by_bucket"] == {"1": 1, "2": 0, "4": 1, "8": 0}
+        assert st["rows_padded"] == 1  # vs 12 padding both flushes to 8
+    finally:
+        mb.close()
+
+
+def _gated_echo(calls, gate):
+    """Echo runner whose FIRST flush parks until ``gate`` — lets a test
+    queue both lanes behind a flush in flight, then observe exactly how
+    the next flush admits them."""
+
+    def run(batch, n_real):
+        calls.append(({k: v.copy() for k, v in batch.items()}, n_real))
+        if not gate.is_set():
+            gate.wait(10.0)
+        return batch["x"] * 2.0, {}
+
+    return run
+
+
+def test_micro_batcher_weighted_admission_packs_online_first():
+    """Both lanes queued: online rows lead the flush even when bulk queued
+    FIRST, and the head online request is exempt from the weighted cap (a
+    wide online request must not starve behind a standing bulk queue)."""
+    calls = []
+    gate = threading.Event()
+    mb = MicroBatcher(_gated_echo(calls, gate), TMPL, max_batch=4,
+                      max_delay_ms=5, bulk_weight=0.25)
+    try:
+        mb.submit({"x": np.zeros((1, 2), np.float32)})  # occupies the flusher
+        deadline = time.monotonic() + 5.0
+        while mb.stats()["queued"] != 0 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        hb = mb.submit({"x": np.full((1, 2), 5.0, np.float32)}, lane="bulk")
+        # 3 rows > cap_online (4 - 4*0.25 = 3 is the cap; head exemption
+        # makes the full max_batch available to it).
+        ho = mb.submit({"x": np.full((3, 2), 7.0, np.float32)})
+        gate.set()
+        assert np.all(hb.result(5.0)[0] == 10.0)
+        assert np.all(ho.result(5.0)[0] == 14.0)
+        batch, n_real = calls[1]
+        assert n_real == 4
+        # Online's 3 rows lead; bulk trickles in the remaining slot.
+        assert np.all(batch["x"][:3] == 7.0) and np.all(batch["x"][3] == 5.0)
+        st = mb.stats()
+        assert st["lanes"]["online"]["rows_served"] == 4  # dummy + 3
+        assert st["lanes"]["bulk"]["rows_served"] == 1
+    finally:
+        gate.set()
+        mb.close()
+
+
+def test_micro_batcher_bulk_trickle_guaranteed_under_online_pressure():
+    """Online demand exceeding the batch: the weighted cap holds the excess
+    online request to the NEXT flush so bulk still drains at its reserved
+    trickle — weighted admission, not strict starvation-prone priority."""
+    calls = []
+    gate = threading.Event()
+    mb = MicroBatcher(_gated_echo(calls, gate), TMPL, max_batch=4,
+                      max_delay_ms=5, bulk_weight=0.25)
+    try:
+        mb.submit({"x": np.zeros((1, 2), np.float32)})  # occupies the flusher
+        deadline = time.monotonic() + 5.0
+        while mb.stats()["queued"] != 0 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        hb = [mb.submit({"x": np.full((1, 2), 5.0, np.float32)}, lane="bulk")
+              for _ in range(2)]
+        ho = [mb.submit({"x": np.full((2, 2), 7.0, np.float32)})
+              for _ in range(2)]
+        gate.set()
+        for h in hb + ho:
+            h.result(5.0)
+        # Flush #2: online's first 2 rows (cap 3 blocks the second online
+        # request) + both bulk rows.  Flush #3: the deferred online pair.
+        batch2, n2 = calls[1]
+        assert n2 == 4
+        assert np.all(batch2["x"][:2] == 7.0) and np.all(batch2["x"][2:] == 5.0)
+        batch3, n3 = calls[2]
+        assert n3 == 2 and np.all(batch3["x"][:2] == 7.0)
+    finally:
+        gate.set()
+        mb.close()
+
+
+def test_micro_batcher_shed_bulk_first_with_exact_attribution():
+    """Overload ordering: bulk sheds at its own lane bound, an online
+    submit at the TOTAL bound evicts the newest queued bulk (which fails
+    structured) before online would ever shed itself — and every shed is
+    attributed to its lane in stats()."""
+    gate = threading.Event()
+
+    def parked(batch, n_real):
+        assert gate.wait(10.0)
+        return batch["x"], {}
+
+    one = lambda: {"x": np.ones((1, 2), np.float32)}
+    mb = MicroBatcher(parked, TMPL, max_batch=1, max_delay_ms=1,
+                      max_queue_rows=4, bulk_queue_frac=0.5,
+                      drop_after_s=30.0)
+    try:
+        h_running = mb.submit(one())  # taken by the flusher, parks
+        deadline = time.monotonic() + 5.0
+        while mb.stats()["queued"] != 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        # Bulk lane bound = 4 * 0.5 = 2 rows: third bulk sheds AT ITS OWN
+        # bound while the queue still has capacity online can use.
+        b1 = mb.submit(one(), lane="bulk")
+        b2 = mb.submit(one(), lane="bulk")
+        with pytest.raises(BatcherOverloaded, match="bulk lane"):
+            mb.submit(one(), lane="bulk")
+        assert mb.stats()["lanes"]["bulk"]["shed"] == 1
+        # Online fills to the total bound...
+        o1, o2 = mb.submit(one()), mb.submit(one())
+        # ... and PAST it evicts the newest bulk first: b2 then b1.
+        o3 = mb.submit(one())
+        with pytest.raises(BatcherOverloaded, match="evicted"):
+            b2.result(0.5)
+        o4 = mb.submit(one())
+        with pytest.raises(BatcherOverloaded, match="evicted"):
+            b1.result(0.5)
+        assert mb.stats()["lanes"]["bulk"]["shed"] == 3
+        # No bulk left to evict: only now does online shed itself.
+        with pytest.raises(BatcherOverloaded, match="shedding"):
+            mb.submit(one())
+        st = mb.stats()
+        assert st["lanes"]["online"]["shed"] == 1
+        assert st["shed_overload"] == 4  # lane-summed legacy total
+        gate.set()
+        for h in (h_running, o1, o2, o3, o4):
+            assert h.result(5.0)[0].shape == (1, 2)
+        st = mb.stats()
+        assert st["lanes"]["online"]["rows_served"] == 5
+        assert st["lanes"]["bulk"]["rows_served"] == 0
+    finally:
+        gate.set()
+        mb.close()
+
+
+def test_online_latency_survives_bulk_saturation():
+    """The headline lane guarantee: a bulk flood saturating its lane (sheds
+    observed) must neither shed nor starve online traffic — online p99
+    stays bounded by a couple of flush walls, not by the bulk backlog."""
+
+    def runner(batch, n_real):
+        time.sleep(0.002)  # stands in for one forward
+        return batch["x"], {}
+
+    mb = MicroBatcher(runner, TMPL, max_batch=8, max_delay_ms=1,
+                      max_queue_rows=32, bulk_weight=0.25)
+    stop = threading.Event()
+
+    def bulk_flood():
+        while not stop.is_set():
+            try:
+                mb.submit({"x": np.ones((8, 2), np.float32)}, lane="bulk")
+            except BatcherOverloaded:
+                time.sleep(0.0005)  # lane full: the flood IS saturating
+
+    flooder = threading.Thread(target=bulk_flood)
+    flooder.start()
+    lat = []
+    try:
+        time.sleep(0.05)  # let the bulk backlog establish
+        for _ in range(60):
+            t0 = time.monotonic()
+            out, _ = mb.submit({"x": np.ones((1, 2), np.float32)}).result(5.0)
+            lat.append(time.monotonic() - t0)
+            assert out.shape == (1, 2)
+            time.sleep(0.002)
+    finally:
+        stop.set()
+        flooder.join(5.0)
+    st = mb.stats()
+    mb.close()
+    assert st["lanes"]["bulk"]["shed"] > 0        # bulk lane saturated...
+    assert st["lanes"]["bulk"]["rows_served"] > 0  # ...yet still drained
+    assert st["lanes"]["online"]["shed"] == 0      # online never shed
+    assert st["lanes"]["online"]["expired"] == 0
+    lat.sort()
+    # Bounds are generous for a loaded 1-core CI box; the point is "a few
+    # flush walls", not "the 30 s result timeout" a starved lane would hit.
+    assert lat[len(lat) // 2] < 0.25, lat
+    assert lat[int(len(lat) * 0.99)] < 1.5, lat
+
+
 # ------------------------------------------------------------------ cache
 
 
@@ -310,10 +514,26 @@ def test_checkpoint_watcher_failed_reload_retries(tmp_path):
 
     w = CheckpointWatcher(d, flaky, poll_interval_s=60.0)
     publish_manifest(d, 3)
-    assert w.poke() is False  # failed -> not applied
-    assert w.applied_step() is None
-    assert w.poke() is True  # retried at the next poll
+    # A TRANSIENT failure (OSError) retries INSIDE the poke through the
+    # shared backoff helper — a reload deferred a whole poll interval is a
+    # whole poll interval of stale weights.
+    assert w.poke() is True
     assert calls == [3, 3] and w.applied_step() == 3
+
+    # A non-transient failure (corrupt checkpoint) is NOT hammered in-poke:
+    # it defers to the next poll, which gets exactly one fresh attempt.
+    hard = []
+
+    def bad(step, m):
+        hard.append(step)
+        if len(hard) == 1:
+            raise ValueError("corrupt checkpoint")
+
+    w2 = CheckpointWatcher(d, bad, poll_interval_s=60.0)
+    assert w2.poke() is False and hard == [3]
+    assert w2.applied_step() is None
+    assert w2.poke() is True
+    assert hard == [3, 3] and w2.applied_step() == 3
 
 
 def test_watcher_skips_step_already_loaded_at_startup(tmp_path):
@@ -551,3 +771,37 @@ def test_serving_schemas_match_server_method_table():
     # The method table lives in ServingServer.__init__; pin the contract
     # names so a server-side method add/remove must touch the schema too.
     assert set(SERVING_SCHEMAS) == {"Predict", "ModelInfo"}
+
+
+def test_shed_surfaces_as_resource_exhausted_on_the_wire():
+    """The caller contract everywhere (FleetServingClient never retries a
+    shed; the fleet bench's bulk flood counts sheds by status) branches on
+    RESOURCE_EXHAUSTED — a BatcherOverloaded escaping the Predict handler
+    must map there at the generic-handler boundary, not surface as an
+    unstructured UNKNOWN 'Exception calling application'."""
+    from elasticdl_tpu.common.rpc import make_generic_handler
+
+    def predict(req):
+        raise BatcherOverloaded("queue holds 8 rows (bound 8); shedding")
+
+    gh = make_generic_handler("test.Shed", {"Predict": predict})
+
+    class _Details:
+        method = "/test.Shed/Predict"
+
+    handler = gh.service(_Details())
+
+    class _Aborted(Exception):
+        pass
+
+    class _Ctx:
+        code = None
+
+        def abort(self, code, details):
+            self.code = code
+            raise _Aborted(details)  # real grpc abort() never returns
+
+    ctx = _Ctx()
+    with pytest.raises(_Aborted, match="shedding"):
+        handler.unary_unary({"features": {}}, ctx)
+    assert ctx.code == grpc.StatusCode.RESOURCE_EXHAUSTED

@@ -1,0 +1,535 @@
+"""Serving fleet (r19): the multi-replica front.
+
+Covers the four pieces that make one serving address into a fleet:
+
+- the autoscale CONTROL LAW (windowed online-p99 + shed signals, deadband
+  + streak + cooldown hysteresis) against synthetic scrapes — pure logic,
+  no servers;
+- the p2c client (power-of-two-choices over shared inflight counts,
+  suspect marking, retry-on-another-replica via the shared backoff
+  helper) against stub replicas;
+- the tier-1 fleet smoke: REAL ServingServer replicas in-process behind a
+  ServingFleetController, scale-up under a live ramp (the tight SLO is
+  genuinely blown by real latencies) then scale-down when idle, with p2c
+  traffic spread and bucketed-compile jitsan budgets holding fleet-wide;
+- controller-restart adoption: a second controller over the same r18
+  reattach registry re-owns the still-serving fleet without spawning a
+  single duplicate replica.
+"""
+
+import random
+import threading
+import time
+
+import grpc
+import numpy as np
+import pytest
+
+from elasticdl_tpu.common import gauge as gaugelib
+from elasticdl_tpu.common import jitsan
+from elasticdl_tpu.common.config import JobConfig
+from elasticdl_tpu.master.pod_manager import FakePodBackend
+from elasticdl_tpu.serving.client import FleetServingClient
+from elasticdl_tpu.serving.fleet import (
+    AutoscaleConfig,
+    InProcessServingBackend,
+    ServingFleetController,
+    _delta_quantile,
+)
+
+# --------------------------------------------------- control-law units
+
+
+def test_delta_quantile_windows_between_scrapes():
+    inf = float("inf")
+    cur = {10.0: 100.0, 40.0: 200.0, inf: 200.0}
+    # No previous scrape: the quantile of the whole cumulative history.
+    assert _delta_quantile(cur, None, 0.5) == pytest.approx(10.0)
+    # Window = the 100 observations that landed in (10, 40] since prev.
+    prev = {10.0: 100.0, 40.0: 100.0, inf: 100.0}
+    q = _delta_quantile(cur, prev, 0.99)
+    assert 10.0 < q <= 40.0
+    # Empty window reads as NO SIGNAL, never as "p99 = 0".
+    assert _delta_quantile(cur, cur, 0.99) is None
+    assert _delta_quantile({}, None, 0.99) is None
+
+
+#: Synthetic-histogram grid: an edge inside each regime of the law under
+#: target 50 ms — low (p99 ~9.9 -> slo 0.2), deadband (p99 ~39.7 -> slo
+#: 0.79, between down_slo 0.6 and up_slo 1.0), high (p99 ~99.4 -> slo 2).
+_EDGES = (10.0, 40.0, 100.0, float("inf"))
+
+
+class _SyntheticSignal:
+    """Injectable scrape_fn: per-address CUMULATIVE families, 100 new
+    online-lane observations per scrape in the current mode's bucket —
+    so the controller's windowed differencing sees a steady rate."""
+
+    def __init__(self):
+        self.mode = "low"  # low | mid | high
+        self.shed_online = 0.0
+        self.shed_bulk = 0.0
+        self._cum = {}
+
+    def __call__(self, addr):
+        cum = self._cum.setdefault(addr, {e: 0.0 for e in _EDGES})
+        fill_from = {"low": 10.0, "mid": 40.0, "high": 100.0}[self.mode]
+        for e in _EDGES:
+            if e >= fill_from:
+                cum[e] += 100.0
+        hist = [
+            {"name": "edl_serving_request_ms_bucket",
+             "labels": {"lane": "online",
+                        "le": "+Inf" if e == float("inf") else str(e)},
+             "value": c}
+            for e, c in cum.items()
+        ]
+        sheds = [
+            {"name": "edl_serving_shed_total",
+             "labels": {"lane": "online"}, "value": self.shed_online},
+            {"name": "edl_serving_shed_total",
+             "labels": {"lane": "bulk"}, "value": self.shed_bulk},
+        ]
+        return {
+            "edl_serving_request_ms": {
+                "type": "histogram", "help": "", "samples": hist},
+            "edl_serving_shed_total": {
+                "type": "counter", "help": "", "samples": sheds},
+        }
+
+
+def _unit_controller(sig, **auto_overrides):
+    auto = dict(
+        min_replicas=1, max_replicas=3, poll_s=0.01, target_p99_ms=50.0,
+        up_slo=1.0, down_slo=0.6, up_consecutive=2, down_consecutive=3,
+        cooldown_polls=2,
+    )
+    auto.update(auto_overrides)
+    return ServingFleetController(
+        FakePodBackend(), JobConfig(job_name="fleet-unit"),
+        autoscale=AutoscaleConfig(**auto),
+        autoscale_enabled=False,  # polls driven deterministically
+        gauges=gaugelib.Registry(),
+        scrape_fn=sig,
+    )
+
+
+def test_autoscaler_hysteresis_converges_up_then_down():
+    sig = _SyntheticSignal()
+    ctl = _unit_controller(sig)
+    ctl.start(1)
+    try:
+        # UP: pressure must persist up_consecutive polls before acting.
+        sig.mode = "high"
+        d = ctl.poll_once()
+        assert d["action"] == "" and d["up_streak"] == 1
+        assert d["slo"] == pytest.approx(1.988, abs=0.01)
+        d = ctl.poll_once()
+        assert d["action"] == "up" and d["desired"] == 2
+        # Cooldown: the fleet's response to THIS action is measured before
+        # the next one — pressured polls right after do not act.
+        assert ctl.poll_once()["action"] == ""
+        assert ctl.poll_once()["action"] == ""
+        d = ctl.poll_once()
+        assert d["action"] == "up" and d["desired"] == 3
+        # At max: sustained pressure never overshoots.
+        for _ in range(4):
+            assert ctl.poll_once()["action"] == ""
+        assert ctl.pods.desired() == 3
+
+        # DEADBAND: a borderline signal resets BOTH streaks — the zone
+        # that turns an open-loop ramp into convergence, not flapping.
+        sig.mode = "mid"
+        for _ in range(6):
+            d = ctl.poll_once()
+            assert (d["action"], d["up_streak"], d["down_streak"]) == ("", 0, 0)
+
+        # DOWN: slower on purpose (down_consecutive > up_consecutive).
+        sig.mode = "low"
+        acts = [ctl.poll_once()["action"] for _ in range(3)]
+        assert acts == ["", "", "down"] and ctl.pods.desired() == 2
+        acts = [ctl.poll_once()["action"] for _ in range(5)]
+        assert acts.count("down") == 1 and ctl.pods.desired() == 1
+        # At min: sustained quiet never undershoots.
+        for _ in range(4):
+            assert ctl.poll_once()["action"] == ""
+        assert ctl.pods.desired() == 1
+
+        assert [(e["from"], e["to"]) for e in ctl.events()] == [
+            (1, 2), (2, 3), (3, 2), (2, 1)
+        ]
+    finally:
+        ctl.stop()
+
+
+def test_autoscaler_shed_signals():
+    """Online sheds are scale-up pressure even at low latency (the knee
+    shows as shedding before it shows as p99); bulk sheds only VETO
+    scale-down (expected under shed-bulk-first, not a capacity alarm)."""
+    sig = _SyntheticSignal()
+    ctl = _unit_controller(sig)
+    ctl.start(1)
+    try:
+        sig.mode = "low"
+        d = ctl.poll_once()  # first scrape = shed baseline
+        assert d["shed_online"] == 0 and d["down_streak"] == 1
+        sig.shed_online += 5
+        d = ctl.poll_once()
+        assert d["shed_online"] == 5
+        assert d["up_streak"] == 1 and d["down_streak"] == 0
+        sig.shed_bulk += 3
+        d = ctl.poll_once()
+        assert d["shed_total"] == 3 and d["shed_online"] == 0
+        # Neither up (online is fine) nor down (the window saw sheds).
+        assert d["up_streak"] == 0 and d["down_streak"] == 0
+        d = ctl.poll_once()  # quiet window: down pressure resumes
+        assert d["down_streak"] == 1
+    finally:
+        ctl.stop()
+
+
+def test_scale_down_drains_before_delete_and_up_cancels_drain():
+    """Graceful retirement: a scale-down victim leaves the membership
+    IMMEDIATELY (clients stop picking it before the pod can vanish) but
+    its pod is deleted only after drain_s — and pressure returning
+    mid-drain folds the still-warm victim back in instead of spawning."""
+    sig = _SyntheticSignal()
+    t = [0.0]
+    ctl = ServingFleetController(
+        FakePodBackend(), JobConfig(job_name="fleet-drain"),
+        autoscale=AutoscaleConfig(
+            min_replicas=1, max_replicas=2, poll_s=0.01, target_p99_ms=50.0,
+            up_consecutive=1, down_consecutive=1, cooldown_polls=0,
+            drain_s=5.0,
+        ),
+        autoscale_enabled=False,
+        gauges=gaugelib.Registry(),
+        scrape_fn=sig,
+        clock=lambda: t[0],
+    )
+    ctl.start(2)
+    try:
+        sig.mode = "low"
+        d = ctl.poll_once()
+        assert d["action"] == "down"
+        assert len(ctl.replicas()) == 1 and ctl.pods.desired() == 2
+
+        sig.mode = "high"
+        d = ctl.poll_once()
+        assert d["action"] == "up"
+        # Un-drained, not respawned: same two pods, both in membership.
+        assert len(ctl.replicas()) == 2 and ctl.pods.desired() == 2
+
+        sig.mode = "low"
+        d = ctl.poll_once()
+        assert d["action"] == "down" and ctl.pods.desired() == 2
+        t[0] = 6.0  # past the drain deadline
+        ctl.poll_once()
+        assert ctl.pods.desired() == 1 and len(ctl.replicas()) == 1
+
+        assert [(e["from"], e["to"]) for e in ctl.events()] == [
+            (2, 1), (1, 2), (2, 1)
+        ]
+    finally:
+        ctl.stop()
+
+
+# ------------------------------------------------------- p2c client
+
+
+class _FakeRpcError(grpc.RpcError):
+    def __init__(self, code):
+        self._code = code
+
+    def code(self):
+        return self._code
+
+    def details(self):
+        return "stub failure"
+
+
+class _StubReplica:
+    def __init__(self, name, fail=None):
+        self.name = name
+        self.fail = fail
+        self.calls = 0
+
+    def predict(self, features, timeout_s=30.0, lane="online"):
+        self.calls += 1
+        if self.fail is not None:
+            raise self.fail
+        return {"outputs": [0.5], "model": "stub", "step": 0}
+
+    def close(self):
+        pass
+
+
+def _stub_fleet(names, rng_seed=7):
+    fc = FleetServingClient(list(names), rng=random.Random(rng_seed))
+    with fc._lock:
+        for c in fc._clients.values():
+            c.close()
+        fc._clients = {n: _StubReplica(n) for n in names}
+    return fc
+
+
+def test_fleet_client_p2c_spreads_and_retries_transient_elsewhere():
+    fc = _stub_fleet(["a:1", "b:1"])
+    for _ in range(40):
+        assert fc.predict({"x": [1]})["model"] == "stub"
+    a, b = fc._clients["a:1"], fc._clients["b:1"]
+    assert a.calls > 0 and b.calls > 0  # p2c routed to both
+    assert fc.inflight() == {"a:1": 0, "b:1": 0}  # counts balanced back out
+
+    # One replica turns UNAVAILABLE (mid-retirement): the predict still
+    # succeeds via a re-pick, and the failed replica sits out as suspect.
+    a.fail = _FakeRpcError(grpc.StatusCode.UNAVAILABLE)
+    a.calls = b.calls = 0
+    for _ in range(10):
+        assert fc.predict({"x": [1]})["model"] == "stub"
+    assert b.calls >= 10
+    assert fc._suspect_until.get("a:1", 0.0) > 0.0
+    fc.close()
+
+
+def test_fleet_client_non_transient_errors_surface_immediately():
+    fc = _stub_fleet(["a:1"])
+    stub = fc._clients["a:1"]
+    stub.fail = _FakeRpcError(grpc.StatusCode.INVALID_ARGUMENT)
+    with pytest.raises(grpc.RpcError):
+        fc.predict({"x": [1]})
+    assert stub.calls == 1  # no retry: a schema error is the caller's bug
+    assert fc._suspect_until.get("a:1", 0.0) == 0.0  # and not health signal
+    fc.close()
+
+
+def test_fleet_client_membership_refresh():
+    fc = FleetServingClient(["x:1", "y:1"])
+    assert fc.addresses() == ["x:1", "y:1"]
+    fc.set_replicas(["y:1", "z:1"])  # x retired, z joined
+    assert fc.addresses() == ["y:1", "z:1"]
+    fc.close()
+    assert fc.addresses() == []
+
+
+def test_fleet_client_lingers_retired_channel_until_inflight_drains():
+    """A removed replica's channel must NOT close under a request still
+    riding it (channel close cancels in-flight RPCs as CANCELLED — not
+    retried), and a retired replica that rejoins before draining is
+    resurrected warm instead of redialed."""
+    fc = _stub_fleet(["a:1", "b:1"])
+    stub_a = fc._clients["a:1"]
+    closed = []
+    stub_a.close = lambda: closed.append("a:1")
+
+    started = threading.Event()
+    release = threading.Event()
+
+    def slow_predict(features, timeout_s=30.0, lane="online"):
+        started.set()
+        release.wait(5.0)
+        return {"outputs": [0.5], "model": "stub", "step": 0}
+
+    stub_a.predict = slow_predict
+    # Pin the pick: only a:1 is in the client map when the call starts.
+    fc.set_replicas(["a:1"])
+    t = threading.Thread(target=fc.predict, args=({"x": [1]},))
+    t.start()
+    assert started.wait(5.0)
+    fc.set_replicas(["b:1"])  # a:1 retired mid-flight
+    assert closed == []  # linger: close deferred, request unharmed
+    assert fc.addresses() == ["b:1"]
+
+    # Rejoin while lingering: same object back in the pick set, no redial.
+    fc.set_replicas(["a:1", "b:1"])
+    assert fc._clients["a:1"] is stub_a and fc._retired == {}
+
+    # Retire again and let the request finish: LAST RIDER closes it.
+    fc.set_replicas(["b:1"])
+    release.set()
+    t.join(5.0)
+    assert closed == ["a:1"]
+    assert "a:1" not in fc._inflight and "a:1" not in fc._retired
+    fc.close()
+
+
+# ------------------------------------------- in-process fleet (real jax)
+
+
+def _wide_deep_tiny():
+    # Trainer before the model zoo (zoo -> ops.embedding -> parallel ->
+    # trainer import cycle resolves only in this order).
+    import elasticdl_tpu.parallel.trainer  # noqa: F401
+    from elasticdl_tpu.models.spec import load_model_spec
+
+    return load_model_spec(
+        "elasticdl_tpu.models", "wide_deep.model_spec",
+        buckets=64, embedding_dim=4, hidden=(8,),
+    )
+
+
+def _features(n=1, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "dense": rng.rand(n, 5).astype(np.float32) * 50,
+        "cat": rng.randint(0, 1 << 20, size=(n, 9)),
+    }
+
+
+def _replica_factory(spec, spawned, target_p99_ms=100.0):
+    from elasticdl_tpu.serving.server import ServingServer
+
+    def factory(slot):
+        server = ServingServer(
+            spec, max_batch=8, max_delay_ms=3, batch_buckets=(1, 2, 4),
+            gauges=gaugelib.Registry(),  # own registry: per-replica scrapes
+            gauge_port=0, target_p99_ms=target_p99_ms,
+        )
+        server.warmup()  # readiness implies compiled, like serving/main.py
+        spawned.append(slot)
+        return server.start()
+
+    return factory
+
+
+def test_fleet_smoke_scale_up_then_down(tmp_path, devices):
+    """The tier-1 fleet smoke: 2 real replicas, a short live ramp blows a
+    deliberately tight SLO -> scale to 3; idle windows -> scale back to 2.
+    p2c spreads traffic over every replica and the bucketed-compile jitsan
+    budgets hold fleet-wide (the sanitizer is armed suite-wide: one
+    over-budget retrace anywhere fails this test loudly)."""
+    spec = _wide_deep_tiny()
+    spawned = []
+    # SLO target below one batcher deadline: real traffic MUST blow it —
+    # the scale-up below is driven by genuine latency, not a mock.
+    backend = InProcessServingBackend(
+        _replica_factory(spec, spawned, target_p99_ms=1.0)
+    )
+    ctl = ServingFleetController(
+        backend, JobConfig(job_name="fleet-smoke"),
+        state_path=str(tmp_path / "fleet-pods.json"),
+        autoscale=AutoscaleConfig(
+            min_replicas=2, max_replicas=3, poll_s=0.05, target_p99_ms=1.0,
+            up_consecutive=2, down_consecutive=3, cooldown_polls=1,
+        ),
+        autoscale_enabled=False,  # poll_once-driven: deterministic in CI
+        gauges=gaugelib.Registry(),
+    )
+    fc = None
+    try:
+        ctl.start(2)
+        addrs = ctl.wait_ready(2, timeout_s=60.0)
+        assert len(addrs) == 2 and spawned == [0, 1]
+        fc = FleetServingClient(addrs, rng=random.Random(3))
+
+        def burst(n=20):
+            for i in range(n):
+                r = fc.predict(_features(1, seed=i))
+                assert r["model"] == "wide_deep" and len(r["outputs"]) == 1
+
+        # Ramp up: real request latency (>= one 3 ms batcher deadline) vs
+        # the 1 ms target -> up pressure two polls running -> scale 2->3.
+        burst()
+        d = ctl.poll_once()
+        assert d["slo"] is not None and d["slo"] >= 1.0
+        assert d["action"] == "" and d["up_streak"] == 1
+        burst()
+        d = ctl.poll_once()
+        assert d["action"] == "up"
+        assert ctl.pods.counts()["live"] == 3 and spawned == [0, 1, 2]
+        addrs3 = ctl.wait_ready(3, timeout_s=60.0)
+        fc.set_replicas(addrs3)
+        burst()
+
+        # Both lanes serve through the fleet front.
+        out = fc.predict_outputs(_features(2, seed=99), lane="bulk")
+        assert out.shape == (2,)
+        # Unknown lane: structured schema error at the boundary, no retry.
+        with pytest.raises(grpc.RpcError) as err:
+            fc.predict(_features(1), lane="vip")
+        assert err.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+
+        # Every replica answered (p2c spread), each on its own endpoint.
+        from elasticdl_tpu.common.metrics_http import fetch
+
+        for _name, _saddr, maddr in ctl.replicas():
+            fams = fetch(maddr)
+            served = sum(
+                s["value"]
+                for s in fams["edl_serving_requests_total"]["samples"]
+            )
+            assert served > 0, maddr
+            # Bucketed compiles: flushes landed in declared buckets only.
+            assert "edl_serving_bucket_flushes_total" in fams
+
+        # Ramp down: idle windows read as no-signal -> down pressure ->
+        # retire back to min after down_consecutive quiet polls.
+        acts = [ctl.poll_once()["action"] for _ in range(8)]
+        assert "down" in acts
+        assert ctl.pods.counts()["live"] == 2
+        fc.set_replicas(ctl.wait_ready(2, timeout_s=30.0))
+        assert fc.predict(_features(1))["model"] == "wide_deep"
+
+        # Scale events audit: exactly one up and one down, i.e. the loop
+        # CONVERGED under the ramp instead of flapping.
+        assert [(e["from"], e["to"]) for e in ctl.events()] == [
+            (2, 3), (3, 2)
+        ]
+
+        # jitsan: every replica instance compiled at most its declared
+        # bucket budget (buckets 1/2/4/8 -> budget 4 per instance).
+        st = jitsan.stats().get("trainer.predict_step")
+        assert st is not None and st["budget"] >= 4
+    finally:
+        if fc is not None:
+            fc.close()
+        ctl.stop()
+        backend.close()
+
+
+def test_fleet_controller_restart_adopts_live_replicas(tmp_path, devices):
+    """r18 reattach, serving edition: a controller that dies WITHOUT
+    stop() leaves replicas serving and the registry on disk; its
+    replacement adopts the live fleet instead of spawning duplicates."""
+    spec = _wide_deep_tiny()
+    spawned = []
+    backend = InProcessServingBackend(_replica_factory(spec, spawned))
+    state = str(tmp_path / "fleet-pods.json")
+
+    def controller():
+        return ServingFleetController(
+            backend, JobConfig(job_name="fleet-adopt"),
+            state_path=state,
+            autoscale=AutoscaleConfig(min_replicas=2, max_replicas=2),
+            autoscale_enabled=False,
+            gauges=gaugelib.Registry(),
+        )
+
+    ctl1 = controller()
+    ctl2 = None
+    try:
+        ctl1.start(2)
+        addrs1 = sorted(ctl1.wait_ready(2, timeout_s=60.0))
+        assert len(spawned) == 2
+
+        # Controller "crash": no stop(), no registry removal.  A second
+        # controller over the same state_path re-owns the fleet.
+        ctl2 = controller()
+        ctl2.start(2)
+        addrs2 = sorted(ctl2.wait_ready(2, timeout_s=30.0))
+        assert addrs2 == addrs1      # the SAME live servers, same ports
+        assert len(spawned) == 2     # adopted, not respawned
+        assert ctl2.pods.counts()["live"] == 2
+
+        # The adopted fleet serves: replicas rode the restart through.
+        fc = FleetServingClient(addrs2)
+        try:
+            assert fc.predict(_features(1))["model"] == "wide_deep"
+        finally:
+            fc.close()
+    finally:
+        if ctl2 is not None:
+            ctl2.stop()
+        else:
+            ctl1.stop()
+        backend.close()

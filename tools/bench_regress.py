@@ -120,6 +120,24 @@ EXTRACTORS = {
         **_per_point(d, "p50_ms", LOWER),
         **_per_point(d, "p99_ms", LOWER),
     },
+    # r19 fleet ramp: the aggregate QPS the fleet held inside the online
+    # SLO (up), the single-replica knee on the same substrate (up), the
+    # online p99 at that best point (down), and two zero-baseline gates —
+    # autoscaler flaps (direction reversals beyond the ramp's own
+    # up-then-down shape) and replica relaunches (a crash, or a jitsan
+    # over-budget retrace with GRAFT_JITSAN armed in every replica).
+    "serving_fleet_ramp": lambda d: {
+        "fleet_sla_qps": (
+            (d.get("aggregate") or {}).get("best_sla_qps"), HIGHER),
+        "online_p99_at_sla_ms": (
+            (d.get("aggregate") or {}).get("p99_at_best_sla_ms"), LOWER),
+        "single_replica_knee_qps": (
+            (d.get("single_replica") or {}).get("knee_qps"), HIGHER),
+        "autoscale_flaps": (
+            (d.get("convergence") or {}).get("flaps"), LOWER),
+        "replica_relaunches": (
+            (d.get("convergence") or {}).get("relaunches"), LOWER),
+    },
     "chaos_recovery_and_goodput_under_churn": lambda d: {
         **_per_fleet(d, "examples_per_sec", HIGHER),
         "kill_recovery_time_ms": (
