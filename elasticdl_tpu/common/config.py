@@ -180,6 +180,15 @@ class JobConfig:
     # 1 (default) keeps the flat 1-D mesh.  Must divide the device count
     # (elastic resizes that break divisibility fall back to 1-D).
     dcn_data_parallelism: int = 1
+    # Hybrid-parallel mesh (r20, parallel/mesh.py): > 1 builds the 2-D
+    # (dp, tp) mesh — models declaring a tensor_sharding plan split their
+    # weight matrices over the inner tp axis (Megatron column/row splits)
+    # and the batch shards over the outer dp axis.  This is the CONFIGURED
+    # tensor-parallel degree; elastic reform resolves the legal shape for
+    # the live device count (resolve_2d_shape: dp shrinks first, tp only
+    # degrades along its divisor chain when fewer than tp devices remain).
+    # Mutually exclusive with dcn_data_parallelism > 1.
+    tensor_parallelism: int = 1
 
     # --- collectives (r15, parallel/collectives.py — graftreduce) ---
     # How gradient/metric reductions run over the data-parallel axis:
@@ -394,6 +403,13 @@ class JobConfig:
             raise ValueError("--async_staleness must be >= 1")
         if self.dcn_data_parallelism < 1:
             raise ValueError("--dcn_data_parallelism must be >= 1")
+        if self.tensor_parallelism < 1:
+            raise ValueError("--tensor_parallelism must be >= 1")
+        if self.tensor_parallelism > 1 and self.dcn_data_parallelism > 1:
+            raise ValueError(
+                "--tensor_parallelism and --dcn_data_parallelism are "
+                "mutually exclusive (no 3-D mesh)"
+            )
         # Kept in sync with parallel.collectives.MODES (asserted by
         # tests); not imported from there so this module stays jax-free.
         if self.collective not in ("flat", "hierarchical", "auto"):

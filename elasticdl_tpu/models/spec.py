@@ -99,6 +99,14 @@ class ModelSpec:
     # replicate on a 1-D mesh; on hierarchical (dp, ep) meshes they follow
     # the example dim's dp sharding (trainer._batch_spec_for).
     batch_shard_dim: int = 0
+    # Tensor-parallel sharding plan (r20, the 2D ``(dp, tp)`` mesh): a
+    # callable ``(params) -> tree`` matching the params structure whose
+    # leaves are the int dim each weight shards over the ``tp`` axis
+    # (Megatron column/row splits) or None for replicated leaves.  The
+    # trainer uses it to lay params AND their optimizer moments out on
+    # the tp axis; None (the default) means the model is tp-oblivious
+    # and only ever runs on 1-D / (dp, ep) meshes.
+    tensor_sharding: Optional[Callable[[Params], Any]] = None
     # Example batch (tiny) for compile checks / shape inference.
     example_batch: Optional[Callable[[int], Batch]] = None
     # Inference entry point (the serving tier's forward, and predict-mode

@@ -15,8 +15,21 @@ Dense params are replicated with psum'd grads (the AllReduce strategy), so
 SP composes with the existing trainer unchanged; positions are globalized
 with the device's axis index.
 
-Architecture: pre-RMSNorm blocks, causal MHA (ring), GELU MLP (4x), learned
-positional embedding, weight-tied LM head.  bfloat16 compute, f32 params.
+``model_spec(parallelism="tensor")`` (r20) selects the hybrid-parallel
+variant for the 2D ``(dp, tp)`` mesh instead: Megatron column/row-split
+projections (``wqkv``/``w1`` column-sharded, ``wo``/``w2`` row-sharded
+over ``tp``, declared via ``ModelSpec.tensor_sharding``), batch sharded
+over ``dp`` (``batch_shard_dim=0``), ONE ``tp`` all-reduce per residual
+branch through ``parallel/collectives``'s custom-VJP pair (``tp_grad_sync``
+/ ``tp_all_reduce`` — identity<->psum transposes hand-written because the
+shim's check_vma=False shard_map would transpose psum to psum and
+over-count replicated cotangents by ``tp``).  The same apply runs dense on
+a 1-D mesh (``ctx.tp_axis is None``), which is what a 2D->1D elastic
+re-partition degrades to.
+
+Architecture: pre-RMSNorm blocks, causal MHA (ring, or local full under
+tensor parallelism), GELU MLP (4x), learned positional embedding,
+weight-tied LM head.  bfloat16 compute, f32 params.
 """
 
 from __future__ import annotations
@@ -32,7 +45,7 @@ from jax import lax
 from elasticdl_tpu.common.jax_compat import axis_size
 from elasticdl_tpu.data.codecs import lm_feed
 from elasticdl_tpu.models.spec import ModelSpec
-from elasticdl_tpu.ops.ring_attention import ring_attention
+from elasticdl_tpu.ops.ring_attention import attention_reference, ring_attention
 from elasticdl_tpu.ops.embedding import ParallelContext
 
 
@@ -131,6 +144,125 @@ def _apply(
     return (x @ params["tok_emb"].T.astype(compute_dtype)).astype(jnp.float32)
 
 
+def _tp_block(x, blk, tp_axis, n_heads, compute_dtype):
+    """One pre-norm block, tensor-parallel (Megatron split).
+
+    This rank holds ``wqkv``/``w1`` column shards and ``wo``/``w2`` row
+    shards; ``x`` (the residual stream) and the norm gains are replicated
+    across ``tp``.  Each residual branch costs exactly one tp all-reduce
+    (the *g* op after its row-split matmul); the matching *f* op sits
+    AFTER the norm so the norm gain differentiates against the full,
+    already-summed cotangent rather than one rank's partial.  Attention
+    runs complete locally over this rank's ``n_heads/tp`` heads — head
+    splitting needs no sequence collective at all.
+
+    With ``tp_axis=None`` (1-D mesh, or no mesh) the shards are the full
+    matrices and both collectives drop out: the dense path, bit-identical
+    in every column-split matmul, which is what the mesh2d parity probe
+    leans on.
+    """
+    # Trace-time import: a module-level one would close the ops ->
+    # parallel -> ops import cycle (parallel/__init__ pulls the trainer,
+    # which needs ops.embedding mid-initialization).
+    from elasticdl_tpu.parallel.collectives import tp_all_reduce, tp_grad_sync
+
+    b, l, dim = x.shape
+    tp = axis_size(tp_axis) if tp_axis is not None else 1
+    local_heads = n_heads // tp
+    head_dim = dim // n_heads
+    h = _rms_norm(x, blk["ln1"])
+    if tp_axis is not None:
+        h = tp_grad_sync(h, tp_axis)
+    qkv = h @ blk["wqkv"].astype(compute_dtype)  # [B, L, 3*dim/tp]
+    # HEAD-MAJOR column layout ([q_h | k_h | v_h] per head, heads
+    # consecutive): a contiguous 1/tp column shard is then exactly this
+    # rank's heads with their complete q/k/v — the split the tp sharding
+    # plan's wqkv dim-1 entry produces.  (The sequence-parallel _block
+    # reads the same random init as [all-q | all-k | all-v]; both are
+    # valid labelings of iid columns, but only head-major composes with
+    # contiguous sharding.)
+    qkv = qkv.reshape(b, l, local_heads, 3, head_dim)
+    q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+    att = attention_reference(q, k, v, causal=True)
+    out = att.reshape(b, l, dim // tp) @ blk["wo"].astype(compute_dtype)
+    if tp_axis is not None:
+        out = tp_all_reduce(out, tp_axis)
+    x = x + out
+    h = _rms_norm(x, blk["ln2"])
+    if tp_axis is not None:
+        h = tp_grad_sync(h, tp_axis)
+    h = jax.nn.gelu(h @ blk["w1"].astype(compute_dtype))
+    out = h @ blk["w2"].astype(compute_dtype)
+    if tp_axis is not None:
+        out = tp_all_reduce(out, tp_axis)
+    return x + out
+
+
+def _tp_apply(
+    params,
+    batch,
+    train: bool = False,
+    ctx: ParallelContext = ParallelContext(),
+    n_heads: int = 4,
+    compute_dtype=jnp.bfloat16,
+    remat: bool = True,
+    **_,
+):
+    """Hybrid-parallel forward: batch rows sharded over ``dp`` (each
+    device sees ``[B/dp, L]`` complete sequences — positions need no
+    axis offset), weight shards over ``ctx.tp_axis``."""
+    tokens = batch["tokens"]  # [B_local, L] — full sequences
+    l = tokens.shape[1]
+    tp = axis_size(ctx.tp_axis) if ctx.tp_axis is not None else 1
+    if n_heads % tp:
+        raise ValueError(
+            f"tensor parallelism {tp} does not divide n_heads {n_heads}; "
+            f"pick tp from the head count's divisor chain"
+        )
+    if l > params["pos_emb"].shape[0]:
+        raise ValueError(
+            f"sequence length {l} exceeds max_seq "
+            f"{params['pos_emb'].shape[0]}; raise max_seq in the model spec"
+        )
+    pos = jnp.arange(l)
+    x = params["tok_emb"][tokens] + params["pos_emb"][pos][None]
+    x = x.astype(compute_dtype)
+    block_fn = functools.partial(
+        _tp_block, tp_axis=ctx.tp_axis, n_heads=n_heads,
+        compute_dtype=compute_dtype,
+    )
+    if remat and train:
+        block_fn = jax.checkpoint(block_fn)
+    for name in sorted(params["blocks"]):
+        x = block_fn(x, params["blocks"][name])
+    x = _rms_norm(x, params["ln_f"])
+    return (x @ params["tok_emb"].T.astype(compute_dtype)).astype(jnp.float32)
+
+
+def _tp_dims(params):
+    """The ``ModelSpec.tensor_sharding`` plan: which dim of each weight
+    shards over ``tp``.  Column splits (``wqkv``, ``w1``) shard dim 1 —
+    their outputs are per-rank slices; row splits (``wo``, ``w2``) shard
+    dim 0 — their outputs are partial sums the block's ``tp_all_reduce``
+    completes.  Everything else (embeddings, norm gains) replicates."""
+    return {
+        "tok_emb": None,
+        "pos_emb": None,
+        "ln_f": None,
+        "blocks": {
+            name: {
+                "ln1": None,
+                "wqkv": 1,
+                "wo": 0,
+                "ln2": None,
+                "w1": 1,
+                "w2": 0,
+            }
+            for name in params["blocks"]
+        },
+    }
+
+
 def _loss(logits, batch, mask=None):
     # Mean CE over this device's tokens (mask: whole padded SEQUENCES carry
     # zero weight); the trainer's count/total weighting makes it the global
@@ -168,8 +300,19 @@ def model_spec(
     max_seq: int = 4096,
     seq_len: int = 256,
     remat: bool = True,
+    parallelism: str = "sequence",
 ) -> ModelSpec:
+    """``parallelism`` picks the scale axis: ``"sequence"`` (default,
+    ring attention over a 1-D mesh's sequence shards) or ``"tensor"``
+    (Megatron weight shards over the 2D mesh's ``tp`` axis, batch over
+    ``dp`` — see module docstring)."""
+    if parallelism not in ("sequence", "tensor"):
+        raise ValueError(
+            f"parallelism must be 'sequence' or 'tensor', got {parallelism!r}"
+        )
     dtype = jnp.dtype(compute_dtype)
+    tensor = parallelism == "tensor"
+    apply_fn = _tp_apply if tensor else _apply
     return ModelSpec(
         name="transformer_lm",
         init=functools.partial(
@@ -181,12 +324,15 @@ def model_spec(
             max_seq=max_seq,
         ),
         apply=functools.partial(
-            _apply, n_heads=n_heads, compute_dtype=dtype, remat=remat
+            apply_fn, n_heads=n_heads, compute_dtype=dtype, remat=remat
         ),
         loss=_loss,
         metrics=_metrics,
         optimizer=optax.adamw(learning_rate),
         feed=lm_feed,
         example_batch=functools.partial(_example_batch, seq_len=seq_len),
-        batch_shard_dim=1,  # sequence parallelism (see module docstring)
+        # sequence parallelism shards dim 1 (see module docstring); tensor
+        # parallelism keeps sequences whole and shards examples over dp.
+        batch_shard_dim=0 if tensor else 1,
+        tensor_sharding=_tp_dims if tensor else None,
     )

@@ -149,12 +149,16 @@ class ParallelContext:
     (AllReduce/Local).  ``axis_name`` is the mesh axis the step runs under
     (None when not inside shard_map).  ``embedding_impl`` picks the sharded
     lookup route; ``auto`` resolves per (platform, mesh size) — the trainer
-    resolves it before tracing via :func:`resolve_impl`.
+    resolves it before tracing via :func:`resolve_impl`.  ``tp_axis`` names
+    the tensor-parallel mesh axis on a 2D ``(dp, tp)`` mesh (r20) — models
+    with a ``tensor_sharding`` plan switch their apply to the column/row
+    -split path when it is set; None everywhere else.
     """
 
     axis_name: Optional[str] = None
     sharded_embeddings: bool = False
     embedding_impl: str = IMPL_AUTO
+    tp_axis: Optional[str] = None
 
 
 def row_stride(dim: int) -> int:

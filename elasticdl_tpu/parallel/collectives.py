@@ -64,8 +64,10 @@ hierarchy never see each other.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Optional, Sequence, Tuple, Union
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
@@ -296,6 +298,65 @@ def psum_scatter(
     return lax.psum_scatter(
         x, axis, scatter_dimension=scatter_dimension, tiled=tiled
     )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_all_reduce(x: Any, axis: str):
+    """Megatron's *g* operator — sum partial activations over the
+    tensor-parallel axis (r20, the 2D ``(dp, tp)`` mesh).
+
+    Forward: ``psum`` over ``axis`` (the one per-block activation
+    all-reduce after each row-split matmul).  Backward: IDENTITY — the
+    cotangent arriving at a psum output is already replicated across the
+    tp ranks, and each rank's partial activation contributed linearly,
+    so the true per-rank gradient is that replicated cotangent as-is.
+    This must be a ``custom_vjp``: under the shim's
+    ``check_vma=False`` shard_map JAX transposes psum to psum, which
+    would multiply the replicated cotangent by ``tp``.
+
+    Deliberately flat (no hierarchical route): the mesh places ``tp`` on
+    the inner, cheap hop by construction (mesh.py), and the per-block
+    activation is far below any inter-host residue worth scattering.
+    """
+    return lax.psum(x, axis)
+
+
+def _tp_all_reduce_fwd(x, axis):
+    return lax.psum(x, axis), None
+
+
+def _tp_all_reduce_bwd(axis, _res, g):
+    return (g,)
+
+
+tp_all_reduce.defvjp(_tp_all_reduce_fwd, _tp_all_reduce_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_grad_sync(x: Any, axis: str):
+    """Megatron's *f* operator — identity forward, psum over the
+    tensor-parallel axis backward.
+
+    Placed on a REPLICATED activation right before a column-split
+    matmul: forward is a no-op (every tp rank already holds the full
+    activation), but each rank's branch consumed it independently, so
+    the activation's true gradient is the SUM of the per-rank partials.
+    Without this, parameters upstream of the split (norm gains, the
+    residual stream, embeddings) would see only one rank's partial and
+    the dp-only gradient reduce would never repair it.
+    """
+    return x
+
+
+def _tp_grad_sync_fwd(x, axis):
+    return x, None
+
+
+def _tp_grad_sync_bwd(axis, _res, g):
+    return (lax.psum(g, axis),)
+
+
+tp_grad_sync.defvjp(_tp_grad_sync_fwd, _tp_grad_sync_bwd)
 
 
 def interhost_bytes_per_step(

@@ -18,18 +18,34 @@ a ``jax.sharding.Mesh`` over the currently-live devices.  Two shapes:
   ``jax.devices()`` groups each process's devices contiguously, so
   ``reshape(dcn, -1)`` puts one process (or group of processes) per ``dp``
   row by construction.
+- **2-D hybrid-parallel** (``tensor_parallelism > 1``), axes
+  ``("dp", "tp")``: the INNER ``tp`` axis shards a tensor-parallel model's
+  weight matrices (models declaring ``ModelSpec.tensor_sharding``) and
+  carries the per-block activation all-reduces, so it lives on the cheap
+  hop (consecutive devices — within a host for real multi-host worlds);
+  the outer ``dp`` axis shards the batch.  Elastic reform picks a legal
+  shape via :func:`resolve_2d_shape`: ``tp`` is a MODEL-FIT constraint
+  (the weight shards must keep fitting one device), so a shrinking world
+  loses ``dp`` replicas first and touches ``tp`` only when fewer than
+  ``tp`` devices remain — 8 = tp4 x dp2 -> lose a host -> 4 = tp4 x dp1.
+  ``tp == 1`` degrades to the plain 1-D mesh (the 2D->1D re-partition).
 """
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from elasticdl_tpu.common.log_utils import get_logger
+
 DATA_AXIS = "dp"
 EMBED_AXIS = "ep"
+MODEL_AXIS = "tp"
+
+logger = get_logger("mesh")
 
 
 def create_mesh(
@@ -37,6 +53,7 @@ def create_mesh(
     num_devices: Optional[int] = None,
     axis_name: str = DATA_AXIS,
     dcn_parallelism: int = 1,
+    tensor_parallelism: int = 1,
 ) -> Mesh:
     """Build a mesh over ``devices`` (default: all local devices).
 
@@ -46,6 +63,13 @@ def create_mesh(
 
     ``dcn_parallelism > 1`` builds the 2-D hierarchical ``(dp, ep)`` mesh
     (see module docstring); it must divide the device count.
+
+    ``tensor_parallelism > 1`` builds the 2-D hybrid ``(dp, tp)`` mesh:
+    consecutive devices group into ``tp``-sized model shards (the inner
+    axis), replicated ``n/tp`` ways over the outer ``dp`` axis.  It must
+    divide the device count — elastic callers resolve a legal shape first
+    (:func:`resolve_2d_shape`).  Mutually exclusive with
+    ``dcn_parallelism`` (a 3-D ``(dcn, dp, tp)`` mesh is out of scope).
     """
     if devices is None:
         devices = jax.devices()
@@ -56,6 +80,19 @@ def create_mesh(
                 f"requested {num_devices} devices, only {len(devices)} available"
             )
         devices = devices[:num_devices]
+    if tensor_parallelism > 1:
+        if dcn_parallelism > 1:
+            raise ValueError(
+                "tensor_parallelism and dcn_parallelism are mutually "
+                "exclusive (no 3-D mesh)"
+            )
+        if len(devices) % tensor_parallelism:
+            raise ValueError(
+                f"tensor_parallelism {tensor_parallelism} does not divide "
+                f"{len(devices)} devices (resolve_2d_shape picks legal shapes)"
+            )
+        arr = np.asarray(devices).reshape(-1, tensor_parallelism)
+        return Mesh(arr, (axis_name, MODEL_AXIS))
     if dcn_parallelism <= 1:
         return Mesh(np.asarray(devices), (axis_name,))
     if len(devices) % dcn_parallelism:
@@ -65,6 +102,41 @@ def create_mesh(
         )
     arr = np.asarray(devices).reshape(dcn_parallelism, -1)
     return Mesh(arr, (axis_name, EMBED_AXIS))
+
+
+def resolve_2d_shape(n_devices: int, tensor_parallelism: int) -> Tuple[int, int]:
+    """Legal ``(dp, tp)`` shape for ``n_devices`` live devices under a
+    configured tensor-parallel degree.
+
+    ``tp`` is a model-fit constraint — each device holds ``1/tp`` of the
+    sharded weights, so reform PRESERVES it and shrinks ``dp`` instead
+    (``dp = n // tp``): 8 devices at tp=4 -> (dp=2, tp=4); lose a host ->
+    4 devices -> (dp=1, tp=4).  Only when fewer than ``tp`` devices remain
+    does ``tp`` shrink — to the largest DIVISOR of the configured degree
+    that fits, so head counts and hidden dims divisible by the configured
+    ``tp`` stay divisible by the shrunken one.  ``dp * tp`` may be less
+    than ``n_devices`` (7 devices at tp=2 use 6); the remainder idles
+    until the next reform rather than forcing a ragged axis.
+    """
+    n = int(n_devices)
+    if n < 1:
+        raise ValueError(f"need at least one device, got {n}")
+    tp = max(1, int(tensor_parallelism))
+    while tp > n:
+        tp -= 1
+        while tp > 1 and tensor_parallelism % tp:
+            tp -= 1
+    return n // tp, tp
+
+
+def mesh_shape(mesh: Mesh) -> Tuple[int, int]:
+    """The ``(dp, tp)`` view of any mesh: a 1-D mesh is ``(n, 1)``; a
+    hierarchical ``(dp, ep)`` mesh reports its full device count as dp
+    (no model axis).  One definition shared by gauges, reform trace
+    instants and watch_job so the rendered shape cannot drift."""
+    shape = dict(mesh.shape)
+    tp = int(shape.get(MODEL_AXIS, 1))
+    return int(mesh.devices.size) // tp, tp
 
 
 def dp_factorization(
@@ -79,13 +151,21 @@ def dp_factorization(
     axis size.
 
     ``local_size == 0`` derives the grouping from the mesh itself: the
-    devices along the axis group by ``process_index``, and the
-    factorization is real exactly when those groups are contiguous and
-    equal-sized (how ``jax.devices()`` orders every multi-process world
-    — each process's devices are contiguous).  Anything else — a single
-    host, a 1-device-per-process world, ragged groups — returns the
-    trivial ``(1, n)``: no hierarchy to exploit, callers fall back to
-    flat collectives.
+    devices along the axis group by OWNER PROCESSES, and the
+    factorization is real exactly when those groups are contiguous,
+    equal-sized and disjoint (how ``jax.devices()`` orders every
+    multi-process world — each process's devices are contiguous).  On a
+    multi-axis mesh one axis position spans a whole inner-axis row and
+    may legitimately span processes — the dp axis of a ``(dp, tp)`` mesh
+    with dp=2, tp=4 over 4 two-device processes has each position owned
+    by a distinct PAIR of processes, and factors by those pairs.  A
+    single host, a 1-device-per-process world, or ragged groups return
+    the trivial ``(1, n)``: no hierarchy to exploit, callers fall back
+    to flat collectives.  Orders where owner groups interleave or
+    overlap along the axis (a tp-major device order threading every
+    process through every dp position) also demote to flat — LOUDLY,
+    since a real multi-host world is then paying flat-collective bytes
+    over a layout a reshape would fix.
     """
     axis_dim = list(mesh.axis_names).index(axis_name)
     devs = np.moveaxis(mesh.devices, axis_dim, 0)
@@ -97,25 +177,43 @@ def dp_factorization(
                 f"{axis_name!r} axis size {n}"
             )
         return n // local_size, local_size
-    # One process id per axis position (a position spanning processes —
-    # possible only on multi-axis meshes — breaks the grouping).
-    procs = []
-    for i in range(n):
-        owners = {d.process_index for d in np.atleast_1d(devs[i]).flat}
-        if len(owners) != 1:
-            return 1, n
-        procs.append(owners.pop())
-    runs = []  # contiguous (process, length) runs along the axis
-    for p in procs:
-        if runs and runs[-1][0] == p:
+    # Owner-process SET per axis position (singleton on 1-D meshes; a
+    # whole inner row's owners on multi-axis meshes).
+    owners = [
+        frozenset(d.process_index for d in np.atleast_1d(devs[i]).flat)
+        for i in range(n)
+    ]
+    multi_owner = any(len(o) > 1 for o in owners)
+    runs = []  # contiguous (owner_set, length) runs along the axis
+    for o in owners:
+        if runs and runs[-1][0] == o:
             runs[-1][1] += 1
         else:
-            runs.append([p, 1])
+            runs.append([o, 1])
+
+    def flat(reason: str):
+        if multi_owner and len(frozenset().union(*owners)) > 1:
+            # Positions span processes in a genuinely multi-process world
+            # (multi-axis mesh territory), yet no clean grouping exists:
+            # a real host hierarchy is being hidden by the device order —
+            # say so instead of silently paying flat-collective bytes.
+            logger.warning(
+                "%s axis of this mesh has %s owner groups; demoting to "
+                "flat collectives (no contiguous equal host grouping)",
+                axis_name, reason,
+            )
+        return 1, n
+
     lengths = {length for _, length in runs}
     if len(runs) <= 1 or len(lengths) != 1:
-        return 1, n
-    if len({p for p, _ in runs}) != len(runs):
-        return 1, n  # a process re-appears non-contiguously
+        return flat("ragged")
+    sets = [o for o, _ in runs]
+    if len(set(sets)) != len(sets) or len(frozenset().union(*sets)) != sum(
+        len(s) for s in sets
+    ):
+        # A process re-appears non-contiguously, or two groups overlap
+        # (tp-major / interleaved orders).
+        return flat("interleaved")
     return len(runs), lengths.pop()
 
 

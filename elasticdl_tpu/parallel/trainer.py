@@ -125,16 +125,53 @@ def _path_keys(path) -> Tuple[str, ...]:
     return tuple(keys)
 
 
+def _tp_dim_leaves(params: Any, tp_dims: Any) -> List[Optional[int]]:
+    """Flatten a ``ModelSpec.tensor_sharding`` plan against the params
+    structure, keeping the plan's None leaves (``flatten_up_to`` stops at
+    the params' leaf positions, where a plain ``tree_flatten`` would
+    swallow None as an empty subtree)."""
+    treedef = jax.tree_util.tree_structure(params)
+    if tp_dims is None:
+        return [None] * treedef.num_leaves
+    return treedef.flatten_up_to(tp_dims)
+
+
 def params_partition_specs(
-    params: Any, tables: List[EmbeddingTableSpec], axis_name: str, sharded: bool
+    params: Any,
+    tables: List[EmbeddingTableSpec],
+    axis_name: str,
+    sharded: bool,
+    tp_dims: Any = None,
+    tp_axis: Optional[str] = None,
 ):
-    """Partition-spec tree for params: tables row-sharded, the rest replicated."""
+    """Partition-spec tree for params: tables row-sharded, tensor-parallel
+    leaves (``tp_dims`` — the model's tensor_sharding plan, used only on a
+    2D mesh where ``tp_axis`` is set) sharded on their declared dim over
+    the tp axis, the rest replicated."""
     table_paths = {t.path for t in tables} if sharded else set()
-
-    def spec_for(path, leaf):
-        return P(axis_name) if _path_keys(path) in table_paths else P()
-
-    return jax.tree_util.tree_map_with_path(spec_for, params)
+    paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(params)
+    dims = (
+        _tp_dim_leaves(params, tp_dims)
+        if tp_axis is not None
+        else [None] * len(paths_leaves)
+    )
+    specs = []
+    for (path, leaf), d in zip(paths_leaves, dims):
+        if _path_keys(path) in table_paths:
+            specs.append(P(axis_name))
+        elif d is not None:
+            ndim = len(getattr(leaf, "shape", ()))
+            if not 0 <= d < ndim:
+                raise ValueError(
+                    f"tensor_sharding dim {d} out of range for param "
+                    f"{_path_keys(path)} with {ndim} dims"
+                )
+            entry: List[Any] = [None] * ndim
+            entry[d] = tp_axis
+            specs.append(P(*entry))
+        else:
+            specs.append(P())
+    return jax.tree_util.tree_unflatten(treedef, specs)
 
 
 class _OptShard:
@@ -157,8 +194,10 @@ class _OptShard:
         return f"_OptShard(shape={self.shape}, size={self.size}, padded={self.padded})"
 
 
-#: Plan marker for leaves the dp-sharding leaves alone (mesh-sharded
-#: embedding tables: their optimizer slots already co-shard with the rows).
+#: Plan marker for leaves the dp-sharding leaves alone: mesh-sharded
+#: embedding tables, and (r20) tensor-parallel weight shards — in both
+#: cases the optimizer slots already co-shard with the param, so the
+#: ZeRO flatten/scatter must not touch them.
 _OPT_KEEP = "keep"
 
 
@@ -167,20 +206,26 @@ def opt_shard_plan(
     tables: List[EmbeddingTableSpec],
     sharded_embeddings: bool,
     n_shards: int,
+    tp_dims: Any = None,
 ) -> Any:
-    """Params-structured tree of ``_OptShard`` entries (dense leaves) and
-    ``_OPT_KEEP`` markers (mesh-sharded table leaves)."""
+    """Params-structured tree of ``_OptShard`` entries (dense replicated
+    leaves) and ``_OPT_KEEP`` markers (mesh-sharded table leaves, and
+    tensor-parallel leaves when ``tp_dims`` carries the model's plan on a
+    2D mesh — their moments co-shard over ``tp``, so ZeRO's dp scatter
+    skips them and their grads take the plain dp psum)."""
     table_paths = {t.path for t in tables} if sharded_embeddings else set()
-
-    def entry(path, leaf):
-        if _path_keys(path) in table_paths:
-            return _OPT_KEEP
+    paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(params)
+    dims = _tp_dim_leaves(params, tp_dims)
+    entries = []
+    for (path, leaf), d in zip(paths_leaves, dims):
+        if _path_keys(path) in table_paths or d is not None:
+            entries.append(_OPT_KEEP)
+            continue
         shape = tuple(leaf.shape)
         size = int(np.prod(shape)) if shape else 1
         padded = -(-size // n_shards) * n_shards
-        return _OptShard(shape, size, padded)
-
-    return jax.tree_util.tree_map_with_path(entry, params)
+        entries.append(_OptShard(shape, size, padded))
+    return jax.tree_util.tree_unflatten(treedef, entries)
 
 
 def opt_state_partition_specs(
@@ -314,6 +359,12 @@ class Trainer:
         # builders read it.
         self.jit_budgets: Dict[str, int] = {
             "train_step": 1,
+            # The tp-sharded train step of the 2D (dp, tp) mesh (r20):
+            # a 2D reform re-lowers exactly once like any other reform,
+            # and shape-preserving reforms add zero recompiles — same
+            # fixed-shape promise, separate declaration so the 2D path's
+            # budget is pinned by name (tests/test_mesh2d.py).
+            "train_step_2d": 1,
             "train_scan": 4,
             "eval_step": 1,
             "eval_scan": 4,
@@ -408,12 +459,33 @@ class Trainer:
         axis's (host, local) factorization is a property of THIS mesh, so
         every elastic reform re-derives it, and the subgroup mask resets
         to all-active (contributor count is mesh-shaped).
+
+        Tensor-parallel models (spec.tensor_sharding, r20) on a 2D
+        ``(dp, tp)`` mesh: ``tp_axis`` names the inner model axis and
+        ``reduce_axes`` drops it — the tp axis carries ONLY the model's
+        in-block activation all-reduces; loss/metric/gradient reductions
+        run over ``dp`` alone (tp ranks hold the same examples, and the
+        custom-VJP pair in collectives.py leaves replicated-param grads
+        already complete per rank).  On a 1-D mesh the same model runs
+        dense and ``reduce_axes == batch_axes`` as always — that IS the
+        2D->1D re-partition target.
         """
         self.batch_axes = tuple(mesh.axis_names)
         self.axis_name = mesh.axis_names[-1]  # embedding/sequence axis
+        from elasticdl_tpu.parallel.mesh import MODEL_AXIS
+
+        self.tp_axis = (
+            MODEL_AXIS
+            if self.spec.tensor_sharding is not None
+            and self.batch_axes[-1] == MODEL_AXIS
+            else None
+        )
+        self.reduce_axes = tuple(
+            a for a in self.batch_axes if a != self.tp_axis
+        )
         self.collective = coll.resolve_topology(
             mesh,
-            self.batch_axes,
+            self.reduce_axes,
             mode=getattr(self.config, "collective", coll.AUTO),
             local_size=int(getattr(self.config, "collective_local_size", 0)),
             min_elems=int(
@@ -421,8 +493,10 @@ class Trainer:
             ),
         )
         # Subgroup-mask contributors are EXAMPLE shards, never sequence
-        # slices: a data-parallel model (batch_shard_dim=0) shards
-        # examples over every axis, so every position is a contributor; a
+        # slices or tensor-parallel ranks: a data-parallel model
+        # (batch_shard_dim=0) shards examples over every REDUCE axis, so
+        # each dp position is a contributor (a 2D mesh's tp ranks hold
+        # pieces of the same weights and must never be excluded alone); a
         # sequence-parallel model shards examples over the OUTER axes
         # only — its inner-axis slices hold pieces of the SAME examples,
         # and excluding one slice of an example would train on a tensor
@@ -430,7 +504,7 @@ class Trainer:
         # no example sharding at all: one contributor, exclusion
         # unsupported (the worker's gate self-disables at n <= 1).
         self.contributor_axes = (
-            self.batch_axes
+            self.reduce_axes
             if self.spec.batch_shard_dim == 0
             else self.batch_axes[:-1]
         )
@@ -497,12 +571,33 @@ class Trainer:
             if self.sharded_embeddings
             else set()
         )
+        tp = (
+            int(self.mesh.shape[self.tp_axis])
+            if self.tp_axis is not None
+            else 1
+        )
+        dims = _tp_dim_leaves(
+            state.params,
+            self.spec.tensor_sharding(state.params)
+            if self.tp_axis is not None and self.spec.tensor_sharding
+            else None,
+        )
         sizes = []
-        for path, leaf in jax.tree_util.tree_flatten_with_path(state.params)[0]:
+        for (path, leaf), d in zip(
+            jax.tree_util.tree_flatten_with_path(state.params)[0], dims
+        ):
             if _path_keys(path) in table_paths:
                 continue
-            sizes.append(coll.leaf_elems(leaf))
-        n = coll.contributor_count(self.mesh, self.batch_axes)
+            elems = coll.leaf_elems(leaf)
+            if d is not None:
+                # Tensor-parallel leaf: each rank reduces only its LOCAL
+                # shard's grad over dp — 1/tp of the leaf rides the wire.
+                elems = -(-elems // tp)
+            sizes.append(elems)
+        # The grad reduce runs over the dp axes only (reduce_axes): on the
+        # 2D mesh the (dp x tp) product never all-reduces as one axis —
+        # that is the bytes the 2D layout exists to not move.
+        n = coll.contributor_count(self.mesh, self.reduce_axes)
         return {
             "flat": coll.interhost_bytes_per_step(sizes, n, None),
             "resolved": coll.interhost_bytes_per_step(sizes, n, self.collective),
@@ -527,6 +622,7 @@ class Trainer:
                 # carries embedding traffic).
                 axis_size=self.mesh.shape[self.axis_name],
             ),
+            tp_axis=self.tp_axis,
         )
 
     # ---- elastic re-formation ----
@@ -682,11 +778,18 @@ class Trainer:
         optimizer_sharding mode — so an elastic 4->8->4 resize
         REDISTRIBUTES existing Adam/Adagrad moments instead of rebuilding
         them."""
+        tp_dims = (
+            self.spec.tensor_sharding(state.params)
+            if self.tp_axis is not None and self.spec.tensor_sharding
+            else None
+        )
         p_specs = params_partition_specs(
             state.params,
             self.spec.embedding_tables,
             self.axis_name,
             self.sharded_embeddings,
+            tp_dims=tp_dims,
+            tp_axis=self.tp_axis,
         )
         params = jax.tree.map(jnp.asarray, state.params)
         plan = opt_shard_plan(
@@ -694,6 +797,7 @@ class Trainer:
             self.spec.embedding_tables,
             self.sharded_embeddings,
             self._opt_shard_count(),
+            tp_dims=tp_dims,
         )
         self._opt_plan = (
             plan if self._resolve_opt_sharding(params, plan) else None
@@ -817,7 +921,10 @@ class Trainer:
         """PartitionSpec for one batch leaf.
 
         Data-parallel models (batch_shard_dim=0): the example dim shards
-        over EVERY mesh axis jointly — each device holds B/total examples.
+        over every REDUCE axis jointly — each device holds B/total
+        examples; on a tensor-parallel 2D mesh that means over ``dp``
+        only, REPLICATED along ``tp`` (every tp rank of a dp row works
+        the same examples through its weight shard).
 
         Sequence-parallel models (batch_shard_dim=1): the sequence dim
         shards over the inner axis; on hierarchical meshes the example dim
@@ -828,7 +935,7 @@ class Trainer:
         tokens would weight the wrong examples."""
         d = self.spec.batch_shard_dim
         if d == 0:
-            return P(self.batch_axes)
+            return P(self.reduce_axes)
         if getattr(leaf, "ndim", 0) > d:
             return batch_leaf_spec(self.batch_axes, d)
         outer = self.batch_axes[:-1]
@@ -908,7 +1015,7 @@ class Trainer:
         """This process's contiguous [lo, hi) slice of the batch dimension
         under the data-parallel sharding (union of its addressable devices'
         index slices)."""
-        sh = NamedSharding(self.mesh, P(self.batch_axes))
+        sh = NamedSharding(self.mesh, P(self.reduce_axes))
         idx_map = sh.addressable_devices_indices_map((n_examples,))
         starts = [s[0].start or 0 for s in idx_map.values()]
         stops = [
@@ -1251,7 +1358,9 @@ class Trainer:
         self._train_step = self._structured(
             self._train_steps, build_train_step, batch,
             host_keys=tuple(sorted(self.spec.host_io)),
-            variant_budget=self.jit_budgets["train_step"],
+            variant_budget=self.jit_budgets[
+                "train_step_2d" if self.tp_axis is not None else "train_step"
+            ],
             **self._train_build_kwargs(),
         )
         return self._train_step(state, batch, self._active_device())
@@ -1380,7 +1489,11 @@ def build_train_step(
     just the embedding axis — the 1-D mesh).  Reductions of loss/metrics/
     dense grads run over all of them; sharded-table grads get only the
     NON-embedding axes' psum (their transpose already summed within the
-    embedding axis).
+    embedding axis).  On a tensor-parallel 2D mesh (``ctx.tp_axis``, r20)
+    the tp axis is dropped from every reduction here: tp ranks see the
+    same examples, the model's own f/g collectives already complete
+    replicated-leaf grads per rank, and tp-sharded leaves' grads ARE the
+    local shard's — summing any of it over tp would double-count.
 
     ``scan_steps=True``: the function takes STACKED batches ([T, ...] per
     leaf, T = steps) and runs all T steps inside one ``lax.scan`` — ONE
@@ -1395,6 +1508,8 @@ def build_train_step(
     axis = ctx.axis_name
     assert axis is not None
     axes = tuple(batch_axes) if batch_axes else (axis,)
+    if ctx.tp_axis is not None:
+        axes = tuple(a for a in axes if a != ctx.tp_axis)
     dcn_axes = tuple(a for a in axes if a != axis)
     # Paths of sharded-table grads (params-relative): the collective
     # lookup's transpose sums them within the embedding axis already.
@@ -1628,6 +1743,9 @@ def build_predict_step(
     assert axis is not None
 
     def local_predict(state: TrainState, batch):
+        # Tensor-parallel meshes: outputs are replicated along tp (the
+        # model's final tp_all_reduce completes them on every rank), so
+        # the dp-only out_spec below reassembles the global batch.
         # Serving batches ride with a padding mask the model must not see
         # (``__mask__`` is the micro-batcher's fan-back bookkeeping) —
         # mirror local_eval's pop.
@@ -1639,6 +1757,8 @@ def build_predict_step(
 
     d = spec.batch_shard_dim
     axes = tuple(batch_axes) if batch_axes else (axis,)
+    if ctx.tp_axis is not None:
+        axes = tuple(a for a in axes if a != ctx.tp_axis)
     # Per-example outputs mirror the input batch layout (batch_leaf_spec —
     # the same selector as input sharding and host cotangents).
     out_spec = batch_leaf_spec(axes, d)
@@ -1667,6 +1787,10 @@ def build_eval_step(
     axis = ctx.axis_name
     assert axis is not None
     axes = tuple(batch_axes) if batch_axes else (axis,)
+    if ctx.tp_axis is not None:
+        # Metrics reduce over dp only — each tp rank computes identical
+        # metrics from its replicated logits and examples.
+        axes = tuple(a for a in axes if a != ctx.tp_axis)
     # Tail-chunk correctness: the worker wrap-pads the last eval chunk to the
     # static minibatch size and marks real rows in ``__mask__``.  Metrics
     # functions that accept a mask compute means over real examples only;

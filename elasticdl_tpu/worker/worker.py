@@ -48,7 +48,7 @@ from elasticdl_tpu.master.task_dispatcher import (
     Task,
 )
 from elasticdl_tpu.models.spec import ModelSpec, load_model_spec_for_job
-from elasticdl_tpu.parallel.mesh import create_mesh
+from elasticdl_tpu.parallel.mesh import create_mesh, mesh_shape, resolve_2d_shape
 from elasticdl_tpu.parallel.trainer import Trainer, TrainLoopError
 
 logger = get_logger("worker")
@@ -631,7 +631,29 @@ class Worker:
                 dcn, n_dev,
             )
             dcn = 1
-        mesh = create_mesh(self._pool, num_devices=n_dev, dcn_parallelism=dcn)
+        tp_conf = int(getattr(self.config, "tensor_parallelism", 1))
+        if tp_conf > 1:
+            # Hybrid-parallel (r20): reform picks a LEGAL 2D shape for the
+            # live device count — tp preserved (the weight shards must keep
+            # fitting one device), dp shrinks first; tp only degrades along
+            # its divisor chain when fewer than tp devices remain
+            # (mesh.resolve_2d_shape).  The r13/r15 deadline layers sit
+            # ABOVE this choice unchanged: gang membership decides n_dev,
+            # this just decides its factorization.
+            dp, tp = resolve_2d_shape(n_dev, tp_conf)
+            if dp * tp != n_dev:
+                logger.warning(
+                    "tensor_parallelism=%d: %d devices factor to dp=%d x "
+                    "tp=%d; %d device(s) idle until the next reform",
+                    tp_conf, n_dev, dp, tp, n_dev - dp * tp,
+                )
+            mesh = create_mesh(
+                self._pool, num_devices=dp * tp, tensor_parallelism=tp
+            )
+        else:
+            mesh = create_mesh(
+                self._pool, num_devices=n_dev, dcn_parallelism=dcn
+            )
         if initial or self.trainer is None:
             self.trainer = Trainer(self.spec, self.config, mesh)
         elif (
@@ -652,13 +674,18 @@ class Worker:
             )
         else:
             self.reforms += 1
+            old_dp, old_tp = mesh_shape(self.trainer.mesh)
+            new_dp, new_tp = mesh_shape(mesh)
             logger.info(
-                "membership v%d -> re-forming mesh to %d devices",
-                version, mesh.devices.size,
+                "membership v%d -> re-forming mesh to %d devices "
+                "(dp%dxtp%d -> dp%dxtp%d)",
+                version, mesh.devices.size, old_dp, old_tp, new_dp, new_tp,
             )
             trace.instant(
                 "elastic:reform", cat="elastic",
                 version=version, devices=int(mesh.devices.size),
+                old_shape=f"{old_dp}x{old_tp}",
+                new_shape=f"{new_dp}x{new_tp}",
             )
             self.trainer.set_mesh(mesh)
             self._replace_state()
@@ -850,6 +877,16 @@ class Worker:
             self._g_coll_subgroup.set(
                 float(self.trainer.active_contributors().sum())
             )
+            # The live mesh's (dp, tp) shape (mesh.mesh_shape — a 1-D mesh
+            # reads dp=n, tp=1), one sample per axis; watch_job renders
+            # the pair as its "mesh: dpNxtpM" line.
+            dp, tp = mesh_shape(self.trainer.mesh)
+            for ax, val in (("dp", dp), ("tp", tp)):
+                g.gauge(
+                    "edl_mesh_shape",
+                    "current mesh extent per axis (dp=data, tp=model)",
+                    labels={"axis": ax},
+                ).set(float(val))
         for name, secs in self.phases.snapshot().items():
             g.gauge(
                 "edl_phase_seconds_total",

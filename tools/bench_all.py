@@ -402,6 +402,20 @@ def main() -> None:
         "observable) with zero double-train",
     )
     ap.add_argument(
+        "--mesh2d", action="store_true",
+        help="also run the 2D hybrid-mesh bench (tools/mesh2d_bench.py) "
+        "after the training configs; it stamps its own MESH2D artifact — "
+        "1D-vs-2D parity, step time + analytic inter-host bytes across "
+        "(dp, tp) shapes, and the elastic 4x2 -> 4x1 -> 4x2 chaos reform "
+        "with bit-exact moments",
+    )
+    ap.add_argument(
+        "--mesh2d-smoke", action="store_true",
+        help="run ONLY the mesh2d smoke: the 1D-vs-2D parity probe plus "
+        "the chaos reform (4x2 -> 4x1 -> 4x2, bit-exact moments, "
+        "exactly-once, jitsan-armed zero over-budget retraces)",
+    )
+    ap.add_argument(
         "--trace-smoke", action="store_true",
         help="run ONLY the grafttrace overhead smoke: the ingest bench's "
         "--trace A/B (recorder off vs on, same workload) must land under "
@@ -483,6 +497,27 @@ def main() -> None:
             "double-train", file=sys.stderr,
         )
         return
+    if args.mesh2d_smoke:
+        # Subprocess-driven children pin their own fake device counts (the
+        # optshard stance): the smoke measures the 2D re-partitioner, not
+        # the chip.
+        from tools.mesh2d_bench import run_smoke as mesh2d_smoke
+
+        result = mesh2d_smoke(
+            lambda m: print(f"[mesh2d-smoke] {m}", file=sys.stderr, flush=True)
+        )
+        print(json.dumps(result), flush=True)
+        if result["problems"]:
+            for p in result["problems"]:
+                print(f"[mesh2d-smoke] FAIL: {p}", file=sys.stderr)
+            raise SystemExit(1)
+        print(
+            "[mesh2d-smoke] PASS: parity "
+            f"{result['parity']['max_abs_loss_diff']:.2e}, chaos "
+            f"{result['chaos']['path_tp_major']} bit-exact, zero "
+            "over-budget retraces", file=sys.stderr,
+        )
+        return
     if args.trace_smoke:
         # Host-only (no chip probe): the smoke measures the recorder, not
         # the accelerator, and must run on any box.
@@ -558,6 +593,12 @@ def main() -> None:
         # Master + workers all run as subprocesses; this process only
         # watches over gRPC, so it composes with the in-process configs.
         chaos_main(["--masterfail"])
+    if args.mesh2d:
+        from tools.mesh2d_bench import main as mesh2d_main
+
+        # Subprocess-driven (its children pin their own fake device
+        # counts), so running it after the in-process configs is safe.
+        mesh2d_main([])
     if args.collective:
         from tools.collective_bench import main as collective_main
 

@@ -170,6 +170,32 @@ EXTRACTORS = {
             (d.get("chaos") or {}).get("in_step_wait_ms", {})
             .get("subgroup"), LOWER),
     },
+    # 2D hybrid mesh (r20): per-(dp,tp) step time and the analytic
+    # inter-host bytes of the dp-only grad reduce (both down — the bytes
+    # are the traffic the tp dimension exists to not move), plus two
+    # zero-baseline gates: the 1D-vs-2D loss divergence (float32
+    # reduction-order noise at a healthy rev; any climb is a sharded-math
+    # bug) and the chaos reform's moment-mismatch count (bit-exact
+    # re-partitioning or bust).
+    "mesh2d_parity_step_and_bytes": lambda d: {
+        **{
+            f"step_ms[dp{p.get('dp')}xtp{p.get('tp')}]": (
+                p.get("step_ms"), LOWER)
+            for p in d.get("sweep") or [] if isinstance(p, dict)
+        },
+        **{
+            f"interhost_bytes[dp{p.get('dp')}xtp{p.get('tp')}]": (
+                p.get("interhost_bytes_resolved"), LOWER)
+            for p in d.get("sweep") or [] if isinstance(p, dict)
+        },
+        "parity_max_abs_loss_diff": (
+            (d.get("parity") or {}).get("max_abs_loss_diff"), LOWER),
+        "chaos_moment_mismatches": (
+            sum(
+                1 for t in (d.get("chaos") or {}).get("transitions") or []
+                if isinstance(t, dict) and not t.get("moments_bit_exact")
+            ), LOWER),
+    },
     "bench_all_configs": lambda d: {
         f"examples_per_sec_per_chip[{c.get('config')}]": (
             c.get("examples_per_sec_per_chip"), HIGHER)

@@ -1,0 +1,432 @@
+"""mesh2d_bench — the 2D (data x model) mesh's numbers of record
+(artifacts/MESH2D_r20.json).
+
+Three measurement families, each in its OWN subprocess so the XLA fake
+device count (fixed at backend init) is honest per point:
+
+- parity: tensor-mode transformer_lm trained 1D (dp=2) and 2D (dp=2,
+  tp=2) on IDENTICAL batches in one process; the column/row-split
+  projections plus the tp psum must reproduce the dense math, so the
+  max abs loss divergence over the run is float32 reduction-order noise
+  (the row-split matmul sums 1/tp partials through ``tp_all_reduce``).
+  The ISSUE 17 acceptance bar is <= 1e-6 after 10 steps.
+- sweep: step time + the analytic inter-host bytes model
+  (Trainer.collective_bytes_per_step) across (dp, tp) factorizations of
+  8 devices.  The grad reduce runs over dp ONLY and each rank reduces
+  1/tp of every tp-sharded leaf, so resolved bytes fall monotonically
+  as tp rises — the traffic the 2D layout exists to not move.
+- chaos: an in-process Worker job (tensor_parallelism=4, sharded
+  optimizer, jitsan armed) loses a phantom host mid-job and gets it
+  back: tp-major 4x2 -> 4x1 -> 4x2 (dp 2 -> 1 -> 2, tp preserved by
+  mesh.resolve_2d_shape).  Every re-partition must carry the Adam
+  moments BIT-EXACTLY through the canonical host bridge, the job must
+  finish exactly-once, and trainer.train_step must re-lower exactly
+  once per topology (3 total) with zero jitsan over-budget retraces.
+
+Usage:
+    python tools/mesh2d_bench.py [--steps 10] [--out artifacts/MESH2D_r20.json]
+    python tools/mesh2d_bench.py --smoke    # parity (4 steps) + chaos
+                                            # (bench_all --mesh2d-smoke)
+Env override for the artifact path: MESH2D_OUT.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+#: (dp, tp) factorizations of the 8-device pool, widest tp last.
+SWEEP_SHAPES = ((8, 1), (4, 2), (2, 4), (1, 8))
+WARMUP = 3
+
+
+def _child_env(n_devices: int) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={n_devices}"
+    )
+    # The chaos family's compile accounting (and the zero-over-budget
+    # claim) only means something with the sanitizer armed.
+    env["GRAFT_JITSAN"] = "1"
+    return env
+
+
+def _spec(n_heads: int = 4, dim: int = 32, seq: int = 64):
+    """Child-side tensor-mode transformer_lm (import order: trainer
+    before models — the ops<->parallel import cycle predates r20)."""
+    from elasticdl_tpu.parallel.trainer import Trainer  # noqa: F401
+
+    from elasticdl_tpu.models.spec import load_model_spec
+
+    return load_model_spec(
+        "elasticdl_tpu.models", "transformer_lm.model_spec",
+        vocab=256, dim=dim, n_heads=n_heads, n_layers=2,
+        max_seq=seq, seq_len=seq, compute_dtype="float32",
+        parallelism="tensor",
+    )
+
+
+def _batch(rng, b: int, seq: int, vocab: int = 256):
+    import numpy as np
+
+    toks = rng.integers(0, vocab, size=(b, seq + 1)).astype(np.int32)
+    return {"tokens": toks[:, :-1], "labels": toks[:, 1:]}
+
+
+def child_parity(args) -> dict:
+    import jax
+    import numpy as np
+
+    from elasticdl_tpu.parallel.trainer import Trainer
+    from elasticdl_tpu.common.config import JobConfig
+    from elasticdl_tpu.parallel.mesh import create_mesh
+
+    seq = 32
+    spec2d, spec1d = _spec(seq=seq), _spec(seq=seq)
+    cfg = JobConfig(distribution_strategy="AllReduce")
+    t2 = Trainer(spec2d, cfg, create_mesh(num_devices=4, tensor_parallelism=2))
+    t1 = Trainer(spec1d, cfg, create_mesh(num_devices=2))
+    s2 = t2.init_state(jax.random.key(0))
+    s1 = t1.init_state(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    diffs = []
+    for _ in range(args.steps):
+        host = _batch(rng, 8, seq)
+        s2, m2 = t2.train_step(s2, t2.shard_batch(host))
+        s1, m1 = t1.train_step(s1, t1.shard_batch(host))
+        diffs.append(abs(float(m2["loss"]) - float(m1["loss"])))
+    p2 = jax.tree.leaves(jax.device_get(s2.params))
+    p1 = jax.tree.leaves(jax.device_get(s1.params))
+    param_diff = max(
+        float(np.max(np.abs(np.asarray(a) - np.asarray(b)))) if a.size else 0.0
+        for a, b in zip(p2, p1)
+    )
+    return {
+        "shapes": {"flat": {"dp": 2, "tp": 1}, "two_d": {"dp": 2, "tp": 2}},
+        "steps": args.steps,
+        "loss_diffs": [round(d, 9) for d in diffs],
+        "max_abs_loss_diff": max(diffs),
+        "max_abs_param_diff": param_diff,
+    }
+
+
+def child_point(args) -> dict:
+    import jax
+    import numpy as np
+
+    from elasticdl_tpu.parallel.trainer import Trainer
+    from elasticdl_tpu.common.config import JobConfig
+    from elasticdl_tpu.parallel.mesh import create_mesh, mesh_shape
+
+    dp, tp = args.dp, args.tp
+    seq = 64
+    spec = _spec(n_heads=8, dim=64, seq=seq)
+    mesh = (
+        create_mesh(num_devices=dp * tp, tensor_parallelism=tp)
+        if tp > 1 else create_mesh(num_devices=dp)
+    )
+    t = Trainer(spec, JobConfig(distribution_strategy="AllReduce"), mesh)
+    state = t.init_state(jax.random.key(0))
+    b = max(16 // dp * dp, dp)
+    batch = t.shard_batch(_batch(np.random.default_rng(7), b, seq))
+    state, m = t.train_step(state, batch)  # compile
+    jax.block_until_ready(m)
+    for _ in range(WARMUP):
+        state, m = t.train_step(state, batch)
+    jax.block_until_ready(m)
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, m = t.train_step(state, batch)
+    jax.block_until_ready(m)
+    dt = (time.perf_counter() - t0) / args.steps
+    bytes_model = t.collective_bytes_per_step(state)
+    return {
+        "dp": mesh_shape(mesh)[0],
+        "tp": mesh_shape(mesh)[1],
+        "global_batch": b,
+        "step_ms": round(dt * 1e3, 3),
+        "examples_per_sec": round(b / dt, 1),
+        "interhost_bytes_flat": bytes_model["flat"],
+        "interhost_bytes_resolved": bytes_model["resolved"],
+        "loss": round(float(m["loss"]), 6),
+    }
+
+
+def child_chaos(args) -> dict:
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from elasticdl_tpu.parallel.trainer import Trainer  # noqa: F401
+    from elasticdl_tpu.common import jitsan
+    from elasticdl_tpu.common.config import JobConfig
+    from elasticdl_tpu.data.reader import create_data_reader
+    from elasticdl_tpu.data.synthetic import generate
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.models.spec import load_model_spec
+    from elasticdl_tpu.parallel.mesh import mesh_shape
+    from elasticdl_tpu.worker.worker import DirectMasterProxy, Worker
+
+    seq, vocab, n_tasks = 64, 128, 6
+    records_per_task, mb = 8, 4
+    tmp = tempfile.mkdtemp(prefix="mesh2d_chaos_")
+    path = os.path.join(tmp, "lm.rio")
+    generate("lm", path, records_per_task * n_tasks, seq_len=seq, vocab=vocab)
+    spec = load_model_spec(
+        "elasticdl_tpu.models", "transformer_lm.model_spec",
+        vocab=vocab, dim=32, n_heads=4, n_layers=2, max_seq=seq,
+        seq_len=seq, compute_dtype="float32", parallelism="tensor",
+    )
+    config = JobConfig(
+        model_def="transformer_lm.model_spec",
+        distribution_strategy="AllReduce",
+        training_data=path,
+        minibatch_size=mb,
+        tensor_parallelism=4,
+        optimizer_sharding="sharded",
+        # Per-step dispatch (no fused scan): trainer.train_step is then
+        # THE compile site, so "re-lowers exactly once per topology" is
+        # one crisp counter.  lease_batch=1 keeps the GetTask counter a
+        # per-task schedule for the membership injections below.
+        fused_task_scan=False,
+        lease_batch=1,
+    )
+    reader = create_data_reader(path)
+    servicer = MasterServicer(
+        TaskDispatcher(reader.create_shards(records_per_task))
+    )
+    audit = {"transitions": [], "moments_bit_exact": True, "initial": None}
+
+    class AuditWorker(Worker):
+        """Bit-exactness probe on the reform seam: host_state before the
+        canonical re-placement must equal host_state after it."""
+
+        def _replace_state(self):
+            before = jax.device_get(self.trainer.host_state(self.state))
+            super()._replace_state()
+            after = jax.device_get(self.trainer.host_state(self.state))
+            ok = all(
+                np.array_equal(np.asarray(a), np.asarray(b))
+                for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after))
+            )
+            dp, tp = mesh_shape(self.trainer.mesh)
+            audit["transitions"].append(
+                {"dp": dp, "tp": tp, "moments_bit_exact": bool(ok)}
+            )
+            audit["moments_bit_exact"] &= ok
+
+        def _apply_membership(self, membership, initial=False):
+            super()._apply_membership(membership, initial=initial)
+            if audit["initial"] is None and self.trainer is not None:
+                dp, tp = mesh_shape(self.trainer.mesh)
+                audit["initial"] = {"dp": dp, "tp": tp}
+
+    # Phantom pre-registered: the job STARTS at world 2 (8 devices ->
+    # dp2 x tp4); its mid-job leave + rejoin drives 4x2 -> 4x1 -> 4x2
+    # (tp-major, tp preserved — mesh.resolve_2d_shape shrinks dp first).
+    servicer.rendezvous.register("phantom")
+    worker = AuditWorker(
+        config, DirectMasterProxy(servicer), reader,
+        worker_id="w0", spec=spec, devices=jax.devices(),
+        devices_per_worker=4,
+    )
+    orig_get_task = servicer.GetTask
+    counter = {"n": 0}
+
+    def get_task_with_events(req):
+        counter["n"] += 1
+        if counter["n"] == 3:
+            servicer.rendezvous.remove("phantom")
+        elif counter["n"] == 5:
+            servicer.rendezvous.register("phantom")
+        return orig_get_task(req)
+
+    servicer.GetTask = get_task_with_events
+    c0 = jitsan.compiles("trainer.train_step")
+    result = worker.run()
+    status = servicer.JobStatus({})
+    shapes = [audit["initial"]] + [
+        {"dp": t["dp"], "tp": t["tp"]} for t in audit["transitions"]
+    ]
+    path_str = " -> ".join(
+        f"{s['tp']}x{s['dp']}" for s in shapes if s
+    )  # tp-major, the ISSUE's notation
+    train_compiles = jitsan.compiles("trainer.train_step") - c0
+    out = {
+        "shapes": shapes,
+        "path_tp_major": path_str,
+        "reforms": int(result["reforms"]),
+        "steps": int(result["step"]),
+        "tasks_done": int(status["done"]),
+        "tasks_expected": n_tasks,
+        "finished": bool(servicer.dispatcher.finished()),
+        "moments_bit_exact": bool(audit["moments_bit_exact"]),
+        "transitions": audit["transitions"],
+        "jitsan_armed": jitsan.enabled(),
+        "train_step_compiles": train_compiles,
+        "jitsan_stats": jitsan.stats(),
+    }
+    problems = []
+    if result["reforms"] != 2:
+        problems.append(f"expected 2 reforms, saw {result['reforms']}")
+    if not out["finished"] or status["done"] != n_tasks:
+        problems.append(
+            f"exactly-once violated: done={status['done']}/{n_tasks}"
+        )
+    if result["step"] != records_per_task * n_tasks // mb:
+        problems.append(f"step count {result['step']}: work lost or repeated")
+    if not audit["moments_bit_exact"]:
+        problems.append("a re-partition did not carry the moments bit-exactly")
+    if path_str != "4x2 -> 4x1 -> 4x2":
+        problems.append(f"unexpected shape path {path_str!r}")
+    if jitsan.enabled() and train_compiles != 3:
+        problems.append(
+            f"train_step lowered {train_compiles}x, expected 3 "
+            "(once per topology)"
+        )
+    out["problems"] = problems
+    return out
+
+
+def _spawn(extra, n_devices: int, log) -> dict:
+    cmd = [sys.executable, os.path.abspath(__file__), "--child"] + extra
+    log(f"run {' '.join(extra)}")
+    out = subprocess.run(
+        cmd,
+        env=_child_env(n_devices),
+        capture_output=True,
+        text=True,
+        timeout=600,
+        cwd=_REPO,
+    )
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"child {extra} failed rc={out.returncode}: {out.stderr[-800:]}"
+        )
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def run_bench(args, log=None) -> dict:
+    log = log or (lambda m: print(f"[mesh2d] {m}", file=sys.stderr, flush=True))
+    parity = _spawn(
+        ["--task", "parity", "--steps", str(args.steps)], 4, log
+    )
+    log(
+        f"parity: max loss diff {parity['max_abs_loss_diff']:.2e} over "
+        f"{parity['steps']} steps"
+    )
+    sweep = []
+    for dp, tp in SWEEP_SHAPES:
+        row = _spawn(
+            [
+                "--task", "point", "--dp", str(dp), "--tp", str(tp),
+                "--steps", str(args.steps),
+            ],
+            dp * tp, log,
+        )
+        sweep.append(row)
+        log(
+            f"dp={dp} tp={tp}: {row['step_ms']} ms/step, "
+            f"{row['interhost_bytes_resolved']:,} B/step resolved"
+        )
+    chaos = _spawn(["--task", "chaos"], 8, log)
+    log(f"chaos: {chaos['path_tp_major']}, problems={chaos['problems']}")
+    by_tp = {r["tp"]: r for r in sweep}
+    checks = {
+        "parity_ok": parity["max_abs_loss_diff"] <= 1e-6,
+        # Resolved bytes fall monotonically as tp rises: 1/tp of every
+        # tp-sharded leaf over (dp-1)/dp replicas.
+        "bytes_monotonic_in_tp": all(
+            by_tp[a]["interhost_bytes_resolved"]
+            > by_tp[b]["interhost_bytes_resolved"]
+            for a, b in zip((1, 2, 4), (2, 4, 8))
+        ),
+        "chaos_ok": not chaos["problems"],
+    }
+    return {
+        "metric": "mesh2d_parity_step_and_bytes",
+        "model": "transformer_lm tensor-parallel (wqkv/w1 column, wo/w2 row)",
+        "harness": (
+            f"cpu ({os.cpu_count()} core host), fake devices per point; "
+            "bytes are the analytic model of docs/perf.md (no DCN on the "
+            "harness), labeled as such"
+        ),
+        "parity": parity,
+        "sweep": sweep,
+        "chaos": chaos,
+        "checks": checks,
+    }
+
+
+def run_smoke(log) -> dict:
+    """Quick CI face (bench_all --mesh2d-smoke): the parity probe at 4
+    steps plus the full chaos reform — the two correctness families; the
+    step-time sweep stays in the artifact run."""
+    parity = _spawn(["--task", "parity", "--steps", "4"], 4, log)
+    chaos = _spawn(["--task", "chaos"], 8, log)
+    problems = list(chaos["problems"])
+    if parity["max_abs_loss_diff"] > 1e-6:
+        problems.append(
+            f"1D-vs-2D parity {parity['max_abs_loss_diff']:.2e} > 1e-6"
+        )
+    return {"parity": parity, "chaos": chaos, "problems": problems}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python tools/mesh2d_bench.py")
+    ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--task", default="parity", choices=("parity", "point", "chaos"))
+    ap.add_argument("--dp", type=int, default=2)
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--out", default="")
+    args = ap.parse_args(argv)
+    if args.child:
+        result = {
+            "parity": child_parity,
+            "point": child_point,
+            "chaos": child_chaos,
+        }[args.task](args)
+        print(json.dumps(result), flush=True)
+        return 0
+    log = lambda m: print(f"[mesh2d] {m}", file=sys.stderr, flush=True)
+    if args.smoke:
+        result = run_smoke(log)
+        print(json.dumps(result), flush=True)
+        if result["problems"]:
+            for p in result["problems"]:
+                log(f"FAIL: {p}")
+            return 1
+        log(
+            "PASS: parity "
+            f"{result['parity']['max_abs_loss_diff']:.2e}, chaos "
+            f"{result['chaos']['path_tp_major']} bit-exact, zero "
+            "over-budget retraces"
+        )
+        return 0
+    result = run_bench(args, log)
+    from tools.artifact import code_rev, write_artifact
+
+    result["code_rev"] = code_rev()
+    write_artifact(
+        result, "MESH2D_r20.json", env_var="MESH2D_OUT",
+        path=args.out or None,
+    )
+    print(json.dumps(result["checks"]))
+    return 0 if all(result["checks"].values()) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -187,6 +187,28 @@ def render_compiles(
     return "compiles: " + " ".join(parts)
 
 
+def render_mesh(families: Dict[str, dict]) -> Optional[str]:
+    """One ``mesh: dp4xtp2`` line from the ``edl_mesh_shape`` gauge (r20:
+    the worker publishes one sample per axis), or None when the endpoint
+    serves none (pre-2D build, or the trainer not yet formed).  Elastic
+    reforms move this line live — the watcher's view of a 4x2 -> 4x1
+    re-partition."""
+    fam = families.get("edl_mesh_shape")
+    if not fam or not fam["samples"]:
+        return None
+    by_axis = {
+        s["labels"].get("axis", "?"): s["value"] for s in fam["samples"]
+    }
+    parts = [
+        f"{axis}{by_axis[axis]:.0f}"
+        for axis in ("dp", "tp")
+        if axis in by_axis
+    ]
+    if not parts:
+        return None
+    return "mesh: " + "x".join(parts)
+
+
 def render_table(families: Dict[str, dict],
                  prefixes: Optional[List[str]] = None) -> str:
     """One aligned line per series; histograms summarize to
@@ -281,6 +303,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             compiles = render_compiles(families, state["prev"])
             if compiles:
                 print(compiles)
+            mesh = render_mesh(families)
+            if mesh:
+                print(mesh)
             print(render_table(families))
         state["prev"], state["t"] = families, now
 
