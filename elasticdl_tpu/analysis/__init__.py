@@ -90,6 +90,23 @@ v6 adds compile & transfer discipline (``analysis/jit_discipline.py``):
                         materializing helpers propagating to hot
                         callers like ``blocking-propagation``
 
+v7 adds durability discipline (``analysis/durability.py``):
+
+- ``durable-write-discipline``  a write touching a path derived from a
+                        ``# durable-file`` constant must route through
+                        ``common/durable.py`` (atomic publish / fsync'd
+                        append); raw ``os.replace``/``os.rename`` (no
+                        directory fsync) and hand-rolled ``+ ".tmp"``
+                        temp names (no thread-unique component) are
+                        findings anywhere outside durable.py/crashsan.py
+- ``recovery-read-discipline``  a ``# recovery-path`` function reads
+                        durable files only through the shared
+                        torn-tolerant readers (``durable.read_wal`` /
+                        ``read_json_tolerant``); reading a durable path
+                        WITHOUT the annotation is a finding too — the
+                        tolerance window is a declared contract, not an
+                        accident
+
 The runtime twin of ``lock-order`` is ``common/locksan.py``: a debug lock
 wrapper that records actual acquisition orders under ``GRAFT_LOCKSAN=1``
 (on for tier-1 via tests/conftest.py) and raises on inversions or
@@ -97,7 +114,11 @@ leaf-order violations — the static model and the runtime behavior gate
 each other.  ``shared-state``'s runtime twin is ``common/racesan.py``
 (``GRAFT_RACESAN=1``, also tier-1-wide): opted-in classes record
 per-attribute (thread-role, held-locks) observations and raise on a
-cross-role unguarded write.
+cross-role unguarded write.  The durability rules' runtime twin is
+``common/crashsan.py`` (``GRAFT_CRASHSAN=1``, tier-1-wide): every
+durable-write crossing is indexed, and ``crash_at(op, mode)`` forges the
+exact on-disk state a crash at that point leaves so the recovery readers
+are driven through every injectable crash point.
 
 Inline waivers: ``# graftlint: allow[<rule>] <reason>`` — the reason is
 mandatory; malformed waivers are themselves findings (``waiver-syntax``).
@@ -117,6 +138,10 @@ from elasticdl_tpu.analysis.core import (  # noqa: F401
     lint_text,
     run_lint,
     run_lint_full,
+)
+from elasticdl_tpu.analysis.durability import (
+    DurableWriteDisciplinePass,
+    RecoveryReadDisciplinePass,
 )
 from elasticdl_tpu.analysis.gauge_discipline import GaugeDisciplinePass
 from elasticdl_tpu.analysis.hot_path import HotPathSyncPass
@@ -154,4 +179,6 @@ def all_passes() -> list:
         JitShimPass(),
         JitStabilityPass(),
         TransferDisciplinePass(),
+        DurableWriteDisciplinePass(),
+        RecoveryReadDisciplinePass(),
     ]

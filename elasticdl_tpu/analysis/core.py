@@ -60,6 +60,13 @@ KNOWN_RULES = {
     "jit-shim",
     "jit-stability",
     "transfer-discipline",
+    # v7: durability discipline (analysis/durability.py) — writes to
+    # '# durable-file' paths route through common/durable.py (atomic
+    # publish / fsync'd append; no raw renames, no hand-rolled '.tmp'
+    # names), and '# recovery-path' readers use the shared torn-tolerant
+    # readers.  Runtime twin: common/crashsan.py.
+    "durable-write-discipline",
+    "recovery-read-discipline",
     # A waiver that suppresses no finding is itself a finding: the waiver
     # inventory must not rot as code moves (see run_passes).
     "stale-waiver",

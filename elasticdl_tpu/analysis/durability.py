@@ -1,0 +1,500 @@
+"""Durability discipline (v7): durable writes route through common/durable.py.
+
+r18 landed the durable control plane (fsync'd journal, pod registry,
+manifest) and an incident shape to go with it: a membership record that a
+crash left in NEITHER the old journal nor the rotated one.  The root cause
+class — hand-rolled publish/append sequences that each get fsync ordering
+*almost* right — is exactly what a linter can retire.  One canonical home
+(``common/durable.py``) now owns the two durable write shapes; these two
+rules make routing through it mandatory, in the established static-pass +
+runtime-sanitizer pattern (lock-order/locksan, shared-state/racesan,
+jit-*/jitsan; the runtime twin here is ``common/crashsan.py``):
+
+- ``durable-write-discipline``
+    A write touching a path derived from a declared durable constant — a
+    module-level string constant whose assignment line carries
+    ``# durable-file`` — must route through ``common/durable.py``.
+    Derivation is tracked lexically: direct references (``JOURNAL_FILENAME``
+    or ``journal_mod.JOURNAL_FILENAME``), locals assigned from expressions
+    containing one, and ``self.<attr>`` attributes any method of the class
+    assigns from one (``self._path = os.path.join(d, METRICS_FILENAME)``
+    taints ``self._path`` class-wide).  Flagged shapes:
+
+    * builtin ``open`` in a write/append mode (or a dynamic mode) on a
+      tainted path — the raw-write bypass;
+    * ``os.open`` with write-flavored flags (O_WRONLY/O_RDWR/O_APPEND/
+      O_CREAT/O_TRUNC) on a tainted path;
+    * ``os.replace`` / ``os.rename`` with ANY path argument, tainted or
+      not — a rename outside durable.py has no directory fsync, so the
+      rename itself can be lost by a crash (the r18 incident's second
+      half); route through ``atomic_publish`` / ``atomic_replace``;
+    * a hand-rolled ``<path> + ".tmp"`` temp name anywhere — it lacks the
+      thread-unique component ``durable.tmp_path`` provides, so two
+      writers interleave on one temp file; also the tell of a hand-rolled
+      publish sequence.
+
+- ``recovery-read-discipline``
+    A function annotated ``# recovery-path`` (def line or the contiguous
+    comment-only block above — the ``# hot-path`` placement convention) is
+    a crash-recovery reader: what it reads may legally end mid-line (torn
+    final append) and its tolerance window is a contract.  Raw read-mode
+    ``open`` inside one is a finding — route through the shared
+    torn-tolerant readers ``durable.read_wal`` / ``read_json_tolerant`` so
+    every recovery path shares ONE definition of "legal crash artifact".
+    Conversely, a read-mode ``open`` of a tainted path in a function NOT
+    annotated ``# recovery-path`` is a finding too: reading a durable file
+    without declaring the recovery contract is how silent-corruption
+    tolerance creeps in.
+
+Exempt by construction: ``common/durable.py`` (the one legal home of the
+primitives) and ``common/crashsan.py`` (its runtime twin must forge crash
+states with raw syscalls).  ``tests/`` are outside the lint scope as ever.
+
+Blind spots (the crashsan matrix covers them at runtime): paths that reach
+a writer through function PARAMETERS (taint is per-module lexical +
+class-attr), dynamic path construction (``getattr``, dict-of-paths), and
+fsync *ordering* inside a compliant-looking sequence — the static rules
+prove the routing, the sanitizer proves the on-disk crash states recover.
+
+Waive with ``# graftlint: allow[<rule>] <reason>`` on the finding's line
+or a comment-only line above (e.g. metrics.py's advisory flush-only
+appends, whose reader is torn-tolerant by the same contract).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from elasticdl_tpu.analysis.core import Finding, LintPass, SourceFile, attr_chain
+
+#: The canonical durable-write home and its runtime twin: the only files
+#: allowed to spell the raw publish/append/rename sequences.
+EXEMPT_MODULE_SUFFIXES = ("common/durable.py", "common/crashsan.py")
+
+_DURABLE_FILE = re.compile(r"#\s*durable-file\b")
+_RECOVERY_PATH = re.compile(r"#\s*recovery-path\b")
+
+#: os.open flag names that make the fd write-flavored.
+_WRITE_FLAGS = {"O_WRONLY", "O_RDWR", "O_APPEND", "O_CREAT", "O_TRUNC"}
+
+
+def _is_exempt(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return any(p.endswith(suffix) for suffix in EXEMPT_MODULE_SUFFIXES)
+
+
+def _annotated(src: SourceFile, line: int, marker: re.Pattern) -> bool:
+    """Marker on ``line`` or anywhere in the contiguous block of
+    comment-only lines directly above it (the ``# hot-path`` placement
+    convention — markers may share the block with prose)."""
+    comment = src.comments.get(line)
+    if comment is not None and marker.search(comment):
+        return True
+    cand = line - 1
+    while cand in src.comment_only_lines:
+        if marker.search(src.comments[cand]):
+            return True
+        cand -= 1
+    return False
+
+
+def collect_durable_constants(
+    sources: Sequence[SourceFile],
+) -> Dict[str, List[Tuple[str, int, str]]]:
+    """Project-wide harvest of the declared durable constants:
+    ``name -> [(path, line, filename_value), ...]``.  A durable constant is
+    a module-level ``NAME = "<str>"`` whose assignment line carries
+    ``# durable-file``; the NAME is the taint root everywhere (constants
+    are imported by name across modules — ``journal_mod.JOURNAL_FILENAME``
+    taints exactly like a local reference)."""
+    out: Dict[str, List[Tuple[str, int, str]]] = {}
+    for src in sources:
+        for node in src.tree.body:
+            if not (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)
+            ):
+                continue
+            if not _annotated(src, node.lineno, _DURABLE_FILE):
+                continue
+            out.setdefault(node.targets[0].id, []).append(
+                (src.path, node.lineno, node.value.value)
+            )
+    return out
+
+
+def _scope_nodes(fn_body) -> Iterable[ast.AST]:
+    """Every node under ``fn_body``, PRUNING nested def/lambda scopes (the
+    repo-wide traversal stance — deferred execution owns its own
+    judgement)."""
+    stack: List[ast.AST] = list(fn_body)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+class _Taint:
+    """Per-file taint model over the durable-constant roots: which class
+    attributes and (per function) which locals hold a durable path."""
+
+    def __init__(self, src: SourceFile, consts: Set[str]):
+        self.consts = consts
+        #: "<ClassName>" -> set of tainted self-attribute names.
+        self.attrs: Dict[str, Set[str]] = {}
+        for node in src.tree.body:
+            if isinstance(node, ast.ClassDef):
+                self._class_attrs(node)
+
+    def _class_attrs(self, cls: ast.ClassDef) -> None:
+        tainted: Set[str] = set()
+        # Two sweeps: self.b = f(self.a) where self.a was tainted later in
+        # source order (assignment order across methods is runtime order,
+        # not lexical order).
+        for _ in range(2):
+            for meth in cls.body:
+                if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                for n in _scope_nodes(meth.body):
+                    if not isinstance(n, ast.Assign):
+                        continue
+                    if not self._expr_tainted(n.value, tainted, set()):
+                        continue
+                    for t in n.targets:
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                        ):
+                            tainted.add(t.attr)
+        self.attrs[cls.name] = tainted
+
+    def _expr_tainted(
+        self, node: ast.AST, attr_taint: Set[str], local_taint: Set[str]
+    ) -> bool:
+        for s in ast.walk(node):
+            if isinstance(s, ast.Name) and (
+                s.id in self.consts or s.id in local_taint
+            ):
+                return True
+            if isinstance(s, ast.Attribute):
+                if s.attr in self.consts:
+                    return True  # journal_mod.JOURNAL_FILENAME
+                if (
+                    s.attr in attr_taint
+                    and isinstance(s.value, ast.Name)
+                    and s.value.id == "self"
+                ):
+                    return True
+        return False
+
+    def function_locals(
+        self, fn, cls_name: Optional[str]
+    ) -> Set[str]:
+        """Locals of ``fn`` assigned from a tainted expression (two sweeps
+        for chained derivation: ``p = join(d, NAME); q = p + ".bak"``)."""
+        attr_taint = self.attrs.get(cls_name or "", set())
+        local: Set[str] = set()
+        for _ in range(2):
+            for n in _scope_nodes(fn.body):
+                if not isinstance(n, ast.Assign):
+                    continue
+                if not self._expr_tainted(n.value, attr_taint, local):
+                    continue
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        local.add(t.id)
+        return local
+
+    def tainted(
+        self, node: ast.AST, cls_name: Optional[str], local_taint: Set[str]
+    ) -> bool:
+        return self._expr_tainted(
+            node, self.attrs.get(cls_name or "", set()), local_taint
+        )
+
+
+def _open_mode(node: ast.Call) -> Optional[str]:
+    """The builtin-open mode string: second positional or ``mode=``;
+    ``"r"`` when absent; ``None`` when dynamic (not a string constant)."""
+    mode_expr: Optional[ast.expr] = None
+    if len(node.args) >= 2:
+        mode_expr = node.args[1]
+    else:
+        for kw in node.keywords:
+            if kw.arg == "mode":
+                mode_expr = kw.value
+    if mode_expr is None:
+        return "r"
+    if isinstance(mode_expr, ast.Constant) and isinstance(mode_expr.value, str):
+        return mode_expr.value
+    return None
+
+
+def _is_write_mode(mode: Optional[str]) -> bool:
+    """Dynamic modes count as writes — the conservative direction for a
+    durability gate."""
+    if mode is None:
+        return True
+    return any(c in mode for c in "wax+")
+
+
+def _os_open_writes(node: ast.Call) -> bool:
+    """True when an ``os.open`` call's flags reference a write flag."""
+    for arg in node.args[1:]:
+        for s in ast.walk(arg):
+            if isinstance(s, ast.Attribute) and s.attr in _WRITE_FLAGS:
+                return True
+            if isinstance(s, ast.Name) and s.id in _WRITE_FLAGS:
+                return True
+    return False
+
+
+def _iter_functions(src: SourceFile):
+    """``(fn, class_name)`` for every function/method, nested defs
+    included (each is its own taint scope)."""
+    def walk(body, cls_name):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node, cls_name
+                yield from walk(node.body, cls_name)
+            elif isinstance(node, ast.ClassDef):
+                yield from walk(node.body, node.name)
+            elif isinstance(node, (ast.If, ast.Try, ast.With, ast.For, ast.While)):
+                # Functions defined under module-level control flow (the
+                # compat-shim pattern) still get judged.
+                yield from walk(
+                    getattr(node, "body", [])
+                    + getattr(node, "orelse", [])
+                    + getattr(node, "finalbody", []),
+                    cls_name,
+                )
+    yield from walk(src.tree.body, None)
+
+
+class DurableWriteDisciplinePass(LintPass):
+    name = "durable-write-discipline"
+    description = (
+        "writes to '# durable-file' paths route through common/durable.py; "
+        "no raw renames or hand-rolled '.tmp' names anywhere"
+    )
+
+    def run_project(self, files: Sequence[SourceFile]) -> Iterable[Finding]:
+        consts = set(collect_durable_constants(files))
+        findings: List[Finding] = []
+        for src in files:
+            if _is_exempt(src.path):
+                continue
+            taint = _Taint(src, consts)
+            # Unconditional sub-rules walk the whole module (renames and
+            # hand-rolled temp names are findings at module scope too).
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.Call):
+                    chain = attr_chain(node.func)
+                    if chain in ("os.replace", "os.rename"):
+                        findings.append(Finding(
+                            self.name, src.path, node.lineno,
+                            f"raw {chain} publishes without the directory "
+                            "fsync — a crash can lose the rename itself; "
+                            "route through durable.atomic_publish / "
+                            "atomic_replace",
+                        ))
+                elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+                    if (
+                        isinstance(node.right, ast.Constant)
+                        and node.right.value == ".tmp"
+                    ):
+                        findings.append(Finding(
+                            self.name, src.path, node.lineno,
+                            "hand-rolled '+ \".tmp\"' temp name lacks the "
+                            "thread-unique component — two writers "
+                            "interleave on one temp file; use "
+                            "durable.tmp_path (or atomic_publish, which "
+                            "names its own temp)",
+                        ))
+            # Taint-scoped sub-rules per function scope.
+            if not consts:
+                continue
+            for fn, cls_name in _iter_functions(src):
+                local = taint.function_locals(fn, cls_name)
+                for node in _scope_nodes(fn.body):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    f = node.func
+                    if (
+                        isinstance(f, ast.Name)
+                        and f.id == "open"
+                        and node.args
+                        and taint.tainted(node.args[0], cls_name, local)
+                        and _is_write_mode(_open_mode(node))
+                    ):
+                        findings.append(Finding(
+                            self.name, src.path, node.lineno,
+                            "raw write-mode open() of a '# durable-file' "
+                            "path bypasses the durable-write shapes (no "
+                            "single-write guarantee, no fsync, no atomic "
+                            "publish); route through durable.atomic_publish"
+                            " / append_durable",
+                        ))
+                    elif (
+                        attr_chain(f) == "os.open"
+                        and node.args
+                        and taint.tainted(node.args[0], cls_name, local)
+                        and _os_open_writes(node)
+                    ):
+                        findings.append(Finding(
+                            self.name, src.path, node.lineno,
+                            "raw write-flavored os.open of a "
+                            "'# durable-file' path bypasses "
+                            "common/durable.py; use durable.open_append / "
+                            "atomic_publish",
+                        ))
+        return findings
+
+
+class RecoveryReadDisciplinePass(LintPass):
+    name = "recovery-read-discipline"
+    description = (
+        "'# recovery-path' functions read durable files only through "
+        "durable.read_wal / read_json_tolerant; durable files are read "
+        "only from annotated recovery paths"
+    )
+
+    def run_project(self, files: Sequence[SourceFile]) -> Iterable[Finding]:
+        consts = set(collect_durable_constants(files))
+        findings: List[Finding] = []
+        for src in files:
+            if _is_exempt(src.path):
+                continue
+            taint = _Taint(src, consts)
+            for fn, cls_name in _iter_functions(src):
+                is_recovery = _annotated(src, fn.lineno, _RECOVERY_PATH)
+                local = taint.function_locals(fn, cls_name) if consts else set()
+                for node in _scope_nodes(fn.body):
+                    if not (
+                        isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id == "open"
+                        and node.args
+                    ):
+                        continue
+                    mode = _open_mode(node)
+                    if _is_write_mode(mode):
+                        continue  # the write rule's jurisdiction
+                    if is_recovery:
+                        findings.append(Finding(
+                            self.name, src.path, node.lineno,
+                            f"raw open() inside a '# recovery-path' "
+                            f"function {fn.name}(): crash artifacts (torn "
+                            "final line, absent file) need ONE shared "
+                            "tolerance definition — read through "
+                            "durable.read_wal / read_json_tolerant, or "
+                            "waive with the reasoned contract",
+                        ))
+                    elif consts and taint.tainted(node.args[0], cls_name, local):
+                        findings.append(Finding(
+                            self.name, src.path, node.lineno,
+                            f"{fn.name}() reads a '# durable-file' path "
+                            "without the '# recovery-path' annotation — "
+                            "durable files may legally hold crash "
+                            "artifacts; declare the recovery contract and "
+                            "read through durable.read_wal / "
+                            "read_json_tolerant",
+                        ))
+        return findings
+
+
+#: durable.py call names that WRITE (for the --durables inventory).
+_DURABLE_WRITE_API = {
+    "atomic_publish", "atomic_publish_json", "atomic_replace",
+    "append_durable", "open_append",
+}
+_DURABLE_READ_API = {"read_wal", "read_json_tolerant"}
+
+
+def durables_inventory(sources: Sequence[SourceFile]) -> dict:
+    """The ``--durables`` dump: every declared durable constant with its
+    declaration sites, the functions that write through durable.py while
+    referencing it, and its ``# recovery-path`` readers.  The inventory is
+    derived per-module-lexically like the taint itself, so it shows the
+    same world the rules judge — plus one crediting widening the rules
+    don't need: in a constant's DECLARING module, any function calling the
+    durable write/read API (or annotated ``# recovery-path``) counts even
+    without a lexical constant reference, because there the path typically
+    arrives through a constructor parameter (``MasterJournal(path)``) the
+    lexical taint cannot see."""
+    consts = collect_durable_constants(sources)
+    inv: Dict[str, dict] = {
+        name: {
+            "file": sites[0][2],
+            "declared": [f"{p}:{ln}" for p, ln, _v in sites],
+            "writers": [],
+            "recovery_readers": [],
+        }
+        for name, sites in sorted(consts.items())
+    }
+    const_names = set(consts)
+    for src in sources:
+        if _is_exempt(src.path):
+            continue
+        taint = _Taint(src, const_names)
+        for fn, cls_name in _iter_functions(src):
+            refs: Set[str] = set()
+            for n in _scope_nodes(fn.body):
+                if isinstance(n, ast.Name) and n.id in const_names:
+                    refs.add(n.id)
+                elif isinstance(n, ast.Attribute) and n.attr in const_names:
+                    refs.add(n.attr)
+            # A method touching a tainted self-attr references whatever
+            # constants tainted that attr's class; attribute: constant
+            # mapping is not tracked, so attribute-only references credit
+            # every constant the class derives from (coarse but honest —
+            # classes here derive from exactly one).
+            attr_taint = taint.attrs.get(cls_name or "", set())
+            touches_attr = any(
+                isinstance(n, ast.Attribute)
+                and n.attr in attr_taint
+                and isinstance(n.value, ast.Name)
+                and n.value.id == "self"
+                for n in _scope_nodes(fn.body)
+            )
+            if touches_attr and cls_name is not None:
+                for name in const_names:
+                    for p, _ln, _v in consts[name]:
+                        if p == src.path:
+                            refs.add(name)
+            qual = f"{src.path}:{fn.lineno} {fn.name}"
+            writes = reads = False
+            for n in _scope_nodes(fn.body):
+                if isinstance(n, ast.Call):
+                    tail = attr_chain(n.func).split(".")[-1]
+                    if tail in _DURABLE_WRITE_API:
+                        writes = True
+                    elif tail in _DURABLE_READ_API:
+                        reads = True
+            recovery = _annotated(src, fn.lineno, _RECOVERY_PATH)
+            if writes or reads or recovery:
+                # Declaring-module crediting (see docstring).
+                for name in const_names:
+                    if any(p == src.path for p, _ln, _v in consts[name]):
+                        refs.add(name)
+            if not refs:
+                continue
+            for name in sorted(refs):
+                if name not in inv:
+                    continue
+                if writes:
+                    inv[name]["writers"].append(qual)
+                if recovery or reads:
+                    inv[name]["recovery_readers"].append(qual)
+    for rec in inv.values():
+        rec["writers"] = sorted(set(rec["writers"]))
+        rec["recovery_readers"] = sorted(set(rec["recovery_readers"]))
+    return inv

@@ -49,6 +49,17 @@ semicolon-separated faults, comma-separated ``key=value`` args)::
     delay_rpc:method=GetTask,ms=100,count=3
     drop_rpc:method=Heartbeat,count=2,skip=5
     delay_ps:ms=50,count=4
+    torn_write:file=master_journal.wal,op=3  # r21: crash THIS process at
+                                         # its 4th durable op on that
+                                         # file (op= is the per-file
+                                         # 0-based index, exact match),
+                                         # leaving the on-disk state a
+                                         # real mid-op death leaves
+                                         # (common/crashsan.py produces
+                                         # it; mode= picks which —
+                                         # default torn_append for
+                                         # appends, tmp_torn for
+                                         # publishes), then os._exit.
 
 Fault kinds -> hook points (the wire contract with the call sites):
 
@@ -63,6 +74,15 @@ Fault kinds -> hook points (the wire contract with the call sites):
                                       sees a failed RPC, exactly as a
                                       lossy network would present one)
     delay_ps   ps:pull                time.sleep(ms) in the PS handler
+    torn_write durable:write          crashsan produces the exact on-disk
+                                      crash state, then
+                                      os._exit(CHAOS_KILL_EXIT_CODE).
+                                      NOT a hook() crossing: synced into
+                                      crashsan at configure time and
+                                      matched at the durable op itself —
+                                      durable ops fire under leaf
+                                      subsystem locks, where the
+                                      injector's lock may not be taken
 
 Match conditions: ``rank=``/``worker=`` against the process context
 (``set_context``, updated by the worker on every membership apply),
@@ -110,6 +130,10 @@ _KIND_POINTS = {
     "delay_rpc": ("rpc:client",),
     "drop_rpc": ("rpc:client",),
     "delay_ps": ("ps:pull",),
+    # torn_write's "point" is the durable-op crossing in common/durable.py,
+    # reached via crashsan.set_torn_plan at configure time — hook() never
+    # carries it (see _sync_torn_plan), so matches() never sees this kind.
+    "torn_write": ("durable:write",),
 }
 
 #: Keys each fault KIND accepts (typo'd plans must fail loud at parse —
@@ -126,6 +150,11 @@ _KIND_KEYS = {
     "delay_rpc": {"rank", "worker", "step", "method", "ms", "count", "skip"},
     "drop_rpc": {"rank", "worker", "step", "method", "count", "skip"},
     "delay_ps": {"ms", "count", "skip"},
+    # torn_write addresses a durable FILE and its per-file op index, not a
+    # worker identity: durable ops fire in whichever process owns the file
+    # (master WAL/registry, worker checkpoint manifests), and rank/step
+    # conditions could never match the master's crossings.
+    "torn_write": {"file", "op", "mode", "count", "skip"},
 }
 
 
@@ -146,6 +175,13 @@ class ChaosFault:
     # kill only: which PROCESS dies.  "" / "worker" = the worker task
     # boundary (pre-r18 behavior); "master" = the servicer's report hook.
     target: str = ""
+    # torn_write only: which durable file (basename), which of its ops
+    # (per-file 0-based index, EXACT match — unlike step=, a crash point
+    # is one op, not "from op N on"), and which crash mode
+    # (crashsan.ALL_MODES; "" picks the kind's torn default).
+    file: str = ""
+    op: Optional[int] = None
+    mode: str = ""
     # firing state — guarded by the injector's lock
     seen: int = 0
     fired: int = 0
@@ -169,6 +205,10 @@ class ChaosFault:
             if point != f"worker:{self.point or 'step'}":
                 return False
         if self.method and ctx.get("method") != self.method:
+            return False
+        if self.file and ctx.get("file") != self.file:
+            return False
+        if self.op is not None and ctx.get("op") != self.op:
             return False
         if self.shard is not None and ctx.get("shard") != self.shard:
             return False
@@ -204,7 +244,7 @@ def parse_plan(spec: str) -> List[ChaosFault]:
                     f"chaos arg {key!r} does not apply to {kind!r} in "
                     f"{entry!r} (accepted: {sorted(_KIND_KEYS[kind])})"
                 )
-            if key in ("rank", "step", "count", "skip", "shard"):
+            if key in ("rank", "step", "count", "skip", "shard", "op"):
                 kwargs[key] = int(value)
             elif key == "ms":
                 kwargs[key] = float(value)
@@ -232,6 +272,25 @@ def parse_plan(spec: str) -> List[ChaosFault]:
             raise ChaosError(
                 f"{entry!r}: rank=/worker= do not apply to target=master"
             )
+        if fault.kind == "torn_write":
+            from elasticdl_tpu.common import crashsan
+
+            if fault.mode and fault.mode not in crashsan.ALL_MODES:
+                # A typo'd mode would fall back to the default and report
+                # tolerance for a crash shape that was never produced.
+                raise ChaosError(
+                    f"{entry!r}: mode must be one of "
+                    f"{', '.join(crashsan.ALL_MODES)}, got {fault.mode!r}"
+                )
+            if os.sep in fault.file:
+                # Matching is by basename (the hook's ctx); a path could
+                # never match — a fault that silently never fires.
+                raise ChaosError(
+                    f"{entry!r}: file= takes the durable file's basename, "
+                    f"not a path"
+                )
+            if fault.op is not None and fault.op < 0:
+                raise ChaosError(f"{entry!r}: op= must be >= 0")
         if fault.shard is not None and fault.point != "collective":
             # shard= addresses one dp contributor crossing the r15
             # collective gate; no other hook point carries a shard, so
@@ -242,6 +301,26 @@ def parse_plan(spec: str) -> List[ChaosFault]:
             )
         faults.append(fault)
     return faults
+
+
+def _sync_torn_plan(plan: List[ChaosFault]) -> None:
+    """Hand the plan's torn_write faults to crashsan, which owns their
+    matching and firing at the durable-op crossing.  torn_write is the
+    one fault kind that does NOT route through ``hook``/``fire``: durable
+    ops cross under leaf-declared subsystem locks (the master journal
+    appends under TaskDispatcher._lock), where acquiring the injector's
+    locksan-wrapped lock would be a lock-order violation — crashsan's
+    plain leaf lock is the only one that crossing may take."""
+    from elasticdl_tpu.common import crashsan
+
+    crashsan.set_torn_plan([
+        {
+            "file": f.file, "op": f.op, "mode": f.mode,
+            "count": f.count, "skip": f.skip,
+        }
+        for f in plan
+        if f.kind == "torn_write"
+    ])
 
 
 class ChaosInjector:
@@ -259,6 +338,7 @@ class ChaosInjector:
         self._plan: List[ChaosFault] = list(plan or [])
         self._lock = locksan.lock("ChaosInjector._lock", leaf=True)  # lock-order: leaf
         self._ctx: Dict[str, Any] = {}  # guarded-by: _lock
+        _sync_torn_plan(self._plan)
 
     # test seam: a kill must be observable without killing the test runner
     _exit = staticmethod(os._exit)
@@ -279,6 +359,8 @@ class ChaosInjector:
         with self._lock:
             self._plan = list(plan)
             self.enabled = bool(self._plan)
+        # Outside the lock: crashsan's plain lock orders below nothing.
+        _sync_torn_plan(plan)
 
     def stats(self) -> List[dict]:
         """Per-fault seen/fired counters (the bench's injection audit)."""
@@ -326,6 +408,7 @@ class ChaosInjector:
             f"chaos:{fault.kind}", cat="chaos", point=point,
             ms=fault.ms, rank=ctx.get("rank"), method=ctx.get("method"),
             step=ctx.get("step"), shard=ctx.get("shard"), fired=fault.fired,
+            file=ctx.get("file"), op=ctx.get("op"),
         )
         import sys
 
@@ -348,6 +431,9 @@ class ChaosInjector:
                 f"chaos: dropped RPC {ctx.get('method')!r} "
                 f"(fault fired {fault.fired}/{fault.count or 'inf'})"
             )
+        # torn_write never reaches fire: it is synced into crashsan at
+        # configure time (_sync_torn_plan) and fires at the durable-op
+        # crossing itself, under crashsan's plain leaf lock.
 
 
 # -- the process-global injector -------------------------------------------

@@ -23,7 +23,6 @@ replicated one (tests/test_elastic.py).
 
 from __future__ import annotations
 
-import json
 import os
 import time
 from typing import Any, Dict, Optional
@@ -31,7 +30,7 @@ from typing import Any, Dict, Optional
 import jax
 import orbax.checkpoint as ocp
 
-from elasticdl_tpu.common import trace
+from elasticdl_tpu.common import durable, trace
 from elasticdl_tpu.common.log_utils import get_logger
 
 logger = get_logger("checkpoint")
@@ -40,7 +39,7 @@ logger = get_logger("checkpoint")
 #: step dirs naming the newest step whose save (dense state AND host-store
 #: shards) is COMPLETE.  The serving tier's checkpoint watcher keys off this
 #: file — never off directory listings, which show steps mid-write.
-MANIFEST_NAME = "checkpoint_manifest.json"
+MANIFEST_NAME = "checkpoint_manifest.json"  # durable-file
 
 
 def publish_manifest(
@@ -51,15 +50,13 @@ def publish_manifest(
 ) -> str:
     """Atomically publish ``step`` as the newest complete checkpoint.
 
-    Write-to-temp + ``os.replace``: a reader (the serving watcher, possibly
-    in another process) sees either the previous manifest or the new one,
-    never a half-written file — the same commit idiom as the PS shard
-    snapshots (ps/service.PSServer._save).  The caller must only publish
+    The durable.atomic_publish commit: a reader (the serving watcher,
+    possibly in another process) sees either the previous manifest or the
+    new one, never a half-written file.  The caller must only publish
     AFTER the checkpoint itself is fully committed (Orbax wait + host-store
     snapshot): the manifest is the happens-after edge serving relies on.
     """
     path = os.path.join(directory, MANIFEST_NAME)
-    os.makedirs(directory, exist_ok=True)
     payload = {
         "step": int(step),
         "code_rev": code_rev,
@@ -67,12 +64,7 @@ def publish_manifest(
     }
     if extra:
         payload.update(extra)
-    tmp = path + f".tmp{os.getpid()}"
-    with open(tmp, "w") as f:
-        json.dump(payload, f)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    durable.atomic_publish_json(path, payload)
     # The publish is the training->serving hand-off edge: its instant in
     # the merged trace is what publish-to-live latency is measured between
     # (pairs with the watcher's serving:hot_reload instant).
@@ -80,17 +72,14 @@ def publish_manifest(
     return path
 
 
+# recovery-path
 def read_manifest(directory: str) -> Optional[Dict[str, Any]]:
     """The published manifest, or None when absent/unreadable.  Tolerant by
-    design: a missing or garbage manifest means "nothing published yet",
-    not an error — fresh checkpoint dirs and pre-manifest checkpoints both
-    look that way."""
+    design (durable.read_json_tolerant): a missing or garbage manifest
+    means "nothing published yet", not an error — fresh checkpoint dirs
+    and pre-manifest checkpoints both look that way."""
     path = os.path.join(directory, MANIFEST_NAME)
-    try:
-        with open(path) as f:
-            m = json.load(f)
-    except (FileNotFoundError, json.JSONDecodeError, OSError):
-        return None
+    m = durable.read_json_tolerant(path)
     if not isinstance(m, dict) or not isinstance(m.get("step"), int):
         return None
     return m

@@ -226,9 +226,9 @@ def dump_stats(path: Optional[str] = None) -> Optional[str]:
     # reach git — the wall-clock stamp lets the artifact writer compare
     # against HEAD's commit time and flag a stale dump.
     payload["_meta"] = {"utc_s": time.time()}
-    tmp = path + ".tmp"
-    with open(tmp, "w", encoding="utf-8") as f:
-        json.dump(payload, f, indent=1, sort_keys=True)
-        f.write("\n")
-    os.replace(tmp, path)
+    from elasticdl_tpu.common import durable
+
+    durable.atomic_publish(
+        path, json.dumps(payload, indent=1, sort_keys=True) + "\n"
+    )
     return path

@@ -22,6 +22,14 @@ from elasticdl_tpu.common.log_utils import get_logger
 
 logger = get_logger("metrics")
 
+#: The master's JSONL scalar stream under its metrics directory.  Durable
+#: in the WAL-reader sense (torn-tail-tolerant reads via durable.read_wal)
+#: but written ADVISORY: records are flushed, never fsync'd — losing the
+#: page-cache tail of a metrics stream costs observability, not
+#: correctness, and an fsync per scalar report would serialize the
+#: master's report handlers on the disk.
+METRICS_FILENAME = "metrics.jsonl"  # durable-file
+
 #: Metric keys with this prefix carry HISTOGRAM vectors, not scalars.  They
 #: flow through every aggregation layer (device psum, worker minibatch sums,
 #: master cross-worker weighted means) unchanged in meaning — histograms are
@@ -227,8 +235,9 @@ class MetricsWriter:
     def __init__(self, directory: str, tensorboard: bool = True):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
-        self._path = os.path.join(self.directory, "metrics.jsonl")
+        self._path = os.path.join(self.directory, METRICS_FILENAME)
         self._lock = locksan.lock("MetricsWriter._lock", leaf=True)  # lock-order: leaf
+        # graftlint: allow[durable-write-discipline] metrics are advisory: buffered flush-only appends by contract (fsync per scalar would serialize report handlers on the disk); reader is torn-tolerant
         self._f = open(self._path, "a")  # guarded-by: _lock
         self._tb = None
         if tensorboard:
@@ -255,6 +264,7 @@ class MetricsWriter:
                 # A report racing close() (gRPC pool thread vs master
                 # teardown) must not crash the handler: reopen for the
                 # straggler record — append keeps the stream consistent.
+                # graftlint: allow[durable-write-discipline] same advisory-append contract as the primary handle above
                 self._f = open(self._path, "a")
             self._f.write(line + "\n")
             self._f.flush()
@@ -272,27 +282,19 @@ class MetricsWriter:
                 self._tb = None
 
 
+# recovery-path
 def read_metrics(directory: str) -> list:
     """All records of a job's metrics.jsonl (tests, CLI inspection).
 
     Tolerates a torn FINAL line — the one legal artifact of a crash mid-
     append — by dropping it; garbage anywhere earlier still raises (that is
     corruption, not a crash tail, and silently skipping it would hide it).
+    The r12 stance, generalized: durable.read_wal is the one definition.
     """
-    path = os.path.join(os.path.abspath(directory), "metrics.jsonl")
+    from elasticdl_tpu.common import durable
+
+    path = os.path.join(os.path.abspath(directory), METRICS_FILENAME)
     if not os.path.exists(path):
         return []
-    with open(path) as f:
-        lines = f.read().splitlines()
-    records = []
-    last = len(lines) - 1
-    for i, line in enumerate(lines):
-        if not line.strip():
-            continue
-        try:
-            records.append(json.loads(line))
-        except json.JSONDecodeError:
-            if i == last:
-                break  # torn final append: the crash tail, not corruption
-            raise
+    records, _torn = durable.read_wal(path)
     return records

@@ -63,6 +63,7 @@ import json
 import os
 from typing import Dict, List, Optional
 
+from elasticdl_tpu.common import durable
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.master.task_dispatcher import (
     JournalReplayError,
@@ -71,7 +72,7 @@ from elasticdl_tpu.master.task_dispatcher import (
 
 logger = get_logger("master.journal")
 
-JOURNAL_FILENAME = "master_journal.wal"
+JOURNAL_FILENAME = "master_journal.wal"  # durable-file
 
 
 class JournalError(RuntimeError):
@@ -95,10 +96,7 @@ class MasterJournal:
         self._fd: Optional[int] = None
 
     def _open(self) -> None:
-        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-        self._fd = os.open(
-            self.path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644
-        )
+        self._fd = durable.open_append(self.path)
 
     def record(self, ev: dict) -> None:
         """Append one event line and make it durable before returning —
@@ -106,39 +104,28 @@ class MasterJournal:
         follows it."""
         if self._fd is None:
             self._open()
-        data = (json.dumps(ev, sort_keys=True) + "\n").encode()
-        n = os.write(self._fd, data)
-        if n != len(data):
+        data = json.dumps(ev, sort_keys=True) + "\n"
+        try:
+            durable.append_durable(
+                self._fd, data, fsync=self._fsync, path=self.path
+            )
+        except durable.ShortWriteError as e:
             # A short write (signal mid-progress, disk full) left a torn
             # line that later appends would bury MID-file — which replay
-            # rightly treats as corruption.  Finishing the line here
-            # would interleave with other lock domains' appends, so fail
-            # the mutation loudly instead: the caller's RPC errors, the
-            # worker retries, and the record either commits whole or not
-            # at all.
-            raise JournalError(
-                f"short journal append ({n}/{len(data)} bytes) to "
-                f"{self.path} — failing the mutation rather than burying "
-                "a torn line mid-file"
-            )
-        if self._fsync:
-            os.fsync(self._fd)
+            # rightly treats as corruption.  durable.append_durable
+            # already refused to finish the line (finishing would
+            # interleave with other lock domains' appends); surface it as
+            # the journal's own error class: the caller's RPC errors, the
+            # worker retries, and the record commits whole or not at all.
+            raise JournalError(str(e)) from e
 
     def rotate(self, base: dict) -> None:
         """Compaction: atomically replace the WAL with a fresh file whose
-        only record is ``base`` (the CURRENT full state).  temp + fsync +
-        rename, the checkpoint-manifest discipline — a crash mid-rotate
-        leaves either the complete old journal or the complete new one."""
-        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-        tmp = f"{self.path}.tmp{os.getpid()}"
+        only record is ``base`` (the CURRENT full state).  The
+        durable.atomic_publish commit — a crash mid-rotate leaves either
+        the complete old journal or the complete new one."""
         payload = json.dumps(dict(base, kind="base"), sort_keys=True) + "\n"
-        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
-        try:
-            os.write(fd, payload.encode())
-            os.fsync(fd)
-        finally:
-            os.close(fd)
-        os.replace(tmp, self.path)
+        durable.atomic_publish(self.path, payload)
         if self._fd is not None:
             os.close(self._fd)
             self._fd = None
@@ -150,34 +137,22 @@ class MasterJournal:
             self._fd = None
 
 
+# recovery-path
 def read_journal(path: str):
     """Parse the WAL into ``(base, events, torn_tail)``.
 
     A torn FINAL line is tolerated (crash mid-append; the event was never
     acknowledged); unparseable content anywhere else raises
     ``JournalError`` — corruption must fall back loudly, never replay a
-    partial history as if it were whole."""
-    with open(path, "rb") as f:
-        raw = f.read()
-    lines = raw.split(b"\n")
-    # A well-formed file ends with "\n": the final split element is "".
-    records: List[dict] = []
-    torn = False
-    for i, line in enumerate(lines):
-        if not line.strip():
-            continue
-        try:
-            records.append(json.loads(line.decode()))
-        except (ValueError, UnicodeDecodeError) as e:
-            trailing = all(not l.strip() for l in lines[i + 1:])
-            if trailing:
-                torn = True
-                break
-            raise JournalError(
-                f"journal {path} corrupt at line {i + 1} (not a crash "
-                f"tail): {e}"
-            ) from e
-    if not records or records[0].get("kind") != "base":
+    partial history as if it were whole.  The tolerance itself is the
+    shared reader (durable.read_wal) so the stance cannot drift per WAL."""
+    try:
+        records, torn = durable.read_wal(path)
+    except durable.CorruptWalError as e:
+        raise JournalError(str(e)) from e
+    if not records or not isinstance(records[0], dict) or (
+        records[0].get("kind") != "base"
+    ):
         raise JournalError(
             f"journal {path} has no base record — refusing to replay"
         )

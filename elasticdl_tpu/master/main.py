@@ -49,6 +49,11 @@ from elasticdl_tpu.master.task_dispatcher import (
 
 logger = get_logger("master.main")
 
+#: The coarse task-progress watermark under checkpoint_dir: the restart
+#: fallback when the journal is missing/corrupt, and the consistency
+#: anchor tying task progress to the restorable model step.
+PROGRESS_FILENAME = "job_progress.json"  # durable-file
+
 
 def _pick_free_ports(n: int) -> List[int]:
     """``n`` distinct currently-free localhost ports (bind-0 then release).
@@ -125,7 +130,7 @@ class Master:
         # state is ignored when the job shape changed (different data/epoch
         # config — the watermark would skip the wrong shards).
         self._progress_path = (
-            os.path.join(config.checkpoint_dir, "job_progress.json")
+            os.path.join(config.checkpoint_dir, PROGRESS_FILENAME)
             if config.job_type == "training" and config.checkpoint_dir
             else ""
         )
@@ -471,15 +476,14 @@ class Master:
         )
         return replayed
 
+    # recovery-path
     def _load_progress(self, num_shards: int, num_epochs: int):
         if not self._progress_path or not os.path.exists(self._progress_path):
             return None
-        import json
+        from elasticdl_tpu.common import durable
 
-        try:
-            with open(self._progress_path) as f:
-                progress = json.load(f)
-        except (OSError, ValueError):
+        progress = durable.read_json_tolerant(self._progress_path)
+        if not isinstance(progress, dict):
             logger.warning("unreadable job progress file; starting fresh")
             return None
         if (
@@ -515,14 +519,15 @@ class Master:
             return
         import json
 
+        from elasticdl_tpu.common import durable
+
         payload = json.dumps(self.dispatcher.progress(), sort_keys=True)
         if payload == self._last_progress:
             return
-        os.makedirs(os.path.dirname(self._progress_path), exist_ok=True)
-        tmp = f"{self._progress_path}.tmp{os.getpid()}"
-        with open(tmp, "w") as f:
-            f.write(payload)
-        os.replace(tmp, self._progress_path)
+        # The old hand-rolled temp+rename here skipped BOTH fsyncs: a
+        # power loss after the rename could surface an empty/old watermark
+        # under a newer checkpoint.  atomic_publish closes that.
+        durable.atomic_publish(self._progress_path, payload)
         self._last_progress = payload
         # Journal compaction rides the same checkpoint-coupled cadence:
         # the WAL restarts from a fresh full-state base whenever the

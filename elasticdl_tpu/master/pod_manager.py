@@ -37,7 +37,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
-from elasticdl_tpu.common import locksan, racesan, trace
+from elasticdl_tpu.common import durable, locksan, racesan, trace
 from elasticdl_tpu.common.config import JobConfig
 from elasticdl_tpu.common.log_utils import get_logger
 
@@ -72,7 +72,7 @@ WORKER_RESTART_EXIT_CODE = 3
 #: The pod reattach registry's filename under checkpoint_dir (r18): the
 #: ONE spelling Master's wiring, the whole-job-restart probe and the
 #: masterfail bench all reference.
-REGISTRY_FILENAME = "pod_registry.json"
+REGISTRY_FILENAME = "pod_registry.json"  # durable-file
 
 
 def proc_cmdline(pid: int) -> Optional[str]:
@@ -301,8 +301,6 @@ class ProcessPodBackend(PodBackend):
         warmed depth therefore degrade to cold spawns (and the pool
         refills behind them) — spares stay a latency optimization, never
         a correctness dependency."""
-        import json
-
         sig = self._env_sig(full_env)
         with self._lock:
             self._prune_spares_locked(sig)
@@ -324,10 +322,7 @@ class ProcessPodBackend(PodBackend):
                 if k in full_env and k != "ELASTICDL_WORKER_ID"
             },
         }
-        tmp = go_file + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(payload, f)
-        os.replace(tmp, go_file)
+        durable.atomic_publish_json(go_file, payload)
         if self._log_dir is not None:
             # The spare's stdio was bound at spawn (it cannot be
             # redirected now); keep the per-pod-life log contract by
@@ -880,22 +875,29 @@ class PodManager:
 
     # -- reattach registry (r18) --
 
+    # recovery-path
     def _load_registry(self) -> Dict[str, dict]:
         if not self._state_path or not os.path.exists(self._state_path):
             return {}
-        import json
-
+        data = durable.read_json_tolerant(self._state_path)
+        if not isinstance(data, dict):
+            logger.warning(
+                "unreadable pod registry %s; ignoring", self._state_path
+            )
+            return {}
         try:
-            with open(self._state_path) as f:
-                data = json.load(f)
-            slots = data.get("slots") or {}
-            return {str(k): dict(v) for k, v in slots.items()}
-        except (OSError, ValueError, AttributeError):
-            logger.warning("unreadable pod registry %s; ignoring", self._state_path)
+            return {
+                str(k): dict(v) for k, v in (data.get("slots") or {}).items()
+            }
+        except (TypeError, ValueError, AttributeError):
+            logger.warning(
+                "malformed pod registry %s; ignoring", self._state_path
+            )
             return {}
 
     _proc_cmdline = staticmethod(proc_cmdline)
 
+    # recovery-path
     @staticmethod
     def scan_registry(state_path: Optional[str]) -> dict:
         """One-shot registry liveness scan (r18): ``{"recorded": n,
@@ -906,12 +908,12 @@ class PodManager:
         out = {"recorded": 0, "alive": [], "dead": []}
         if not state_path or not os.path.exists(state_path):
             return out
-        import json
-
+        data = durable.read_json_tolerant(state_path)
+        if not isinstance(data, dict):
+            return out
         try:
-            with open(state_path) as f:
-                slots = (json.load(f).get("slots") or {}).values()
-        except (OSError, ValueError, AttributeError):
+            slots = (data.get("slots") or {}).values()
+        except AttributeError:
             return out
         for s in slots:
             if not isinstance(s, dict):
@@ -951,23 +953,18 @@ class PodManager:
                 "name": name, "pid": pid, "relaunches": relaunches,
                 "gen": gen, "cmdline": self._proc_cmdline(pid),
             }
-        import json
-
         try:
-            os.makedirs(os.path.dirname(self._state_path) or ".", exist_ok=True)
-            # Thread-unique tmp: the watcher thread's terminal-event
-            # persist can race a scale()/launch persist IN THIS PROCESS —
-            # a shared pid-only tmp name would let them interleave writes
-            # and os.replace corrupt JSON into the registry, which the
-            # next master's scan would read as "no evidence" and pick a
-            # FULL replay for a genuinely dead fleet.
-            tmp = (
-                f"{self._state_path}.tmp{os.getpid()}."
-                f"{threading.get_ident()}"
+            # durable.atomic_publish's thread-unique temp matters HERE: the
+            # watcher thread's terminal-event persist can race a
+            # scale()/launch persist IN THIS PROCESS — a shared pid-only
+            # temp name would let them interleave writes and os.replace
+            # corrupt JSON into the registry, which the next master's scan
+            # would read as "no evidence" and pick a FULL replay for a
+            # genuinely dead fleet.  (It also adds the fsyncs the old
+            # hand-rolled copy skipped.)
+            durable.atomic_publish_json(
+                self._state_path, {"slots": slots}, sort_keys=True
             )
-            with open(tmp, "w") as f:
-                json.dump({"slots": slots}, f, sort_keys=True)
-            os.replace(tmp, self._state_path)
         except OSError:
             # Advisory state: a failed write costs the NEXT master its
             # adoption shortcut, never this one its launch.

@@ -1201,14 +1201,16 @@ class Trainer:
         root = os.path.join(directory, "host_stores")
         d = os.path.join(root, str(step))
         os.makedirs(d, exist_ok=True)
+        from elasticdl_tpu.common import durable
+
         for key, store in self._host_stores.items():
             # Atomic per-file commit: a crash mid-write must leave either no
             # snapshot (restore falls back to an older step) or a complete
             # one — never a truncated file that poisons every relaunch.
             final = os.path.join(d, f"{key}.bin")
-            tmp = final + ".tmp"
+            tmp = durable.tmp_path(final)
             store.save(tmp)
-            os.replace(tmp, final)
+            durable.atomic_replace(tmp, final)
         steps = sorted(
             (int(s) for s in os.listdir(root) if s.isdigit()), reverse=True
         )

@@ -33,6 +33,7 @@ from typing import Dict, List, Tuple
 
 import numpy as np
 
+from elasticdl_tpu.common import durable
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.ps.service import shard_of, snapshot_filename
 
@@ -76,19 +77,17 @@ def read_snapshot(path: str) -> Tuple[dict, np.ndarray, np.ndarray, np.ndarray]:
 
 
 def write_snapshot(path: str, header: dict, ids, adam_t, rows) -> None:
-    """Write records in the native format, atomically (tmp + rename)."""
+    """Write records in the native format, atomically
+    (durable.atomic_publish: a resharded snapshot must commit whole)."""
     stride = header["stride"]
     recs = np.empty((len(ids),), _record_dtype(stride))
     recs["id"] = np.asarray(ids, np.int64)
     recs["t"] = np.asarray(adam_t, np.int32)
     recs["row"] = np.asarray(rows, np.float32).reshape(len(ids), stride)
-    tmp = path + f".tmp{os.getpid()}"
-    with open(tmp, "wb") as f:
-        f.write(_HEADER.pack(
-            len(ids), header["dim"], stride, header["opt"]
-        ))
-        f.write(recs.tobytes())
-    os.replace(tmp, path)
+    payload = _HEADER.pack(
+        len(ids), header["dim"], stride, header["opt"]
+    ) + recs.tobytes()
+    durable.atomic_publish(path, payload)
 
 
 def _tables_in(step_dir: str) -> Dict[str, Tuple[int, Dict[int, str]]]:

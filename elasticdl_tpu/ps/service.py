@@ -59,6 +59,7 @@ import grpc
 import numpy as np
 
 from elasticdl_tpu import chaos
+from elasticdl_tpu.common import durable
 from elasticdl_tpu.common import gauge as gaugelib
 from elasticdl_tpu.common import locksan, trace
 from elasticdl_tpu.common.log_utils import get_logger
@@ -428,9 +429,12 @@ class PSServer:
                 final = os.path.join(
                     d, snapshot_filename(key, self.shard, self.num_shards)
                 )
-                tmp = final + f".tmp{os.getpid()}"
+                tmp = durable.tmp_path(final)
                 rows[key] = store.save(tmp)
-                os.replace(tmp, final)  # atomic: no torn snapshot files
+                # Full commit (fsync + rename + dir fsync): a shard
+                # rebuild that reads a snapshot the power loss ate would
+                # silently lose embedding rows.
+                durable.atomic_replace(tmp, final)
         keep = int(meta.get("keep_max", 3))
         self._prune(os.path.join(meta["directory"], "host_stores"), keep)
         return {"rows": {k: int(v) for k, v in rows.items()}}, {}

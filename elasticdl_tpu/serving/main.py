@@ -61,10 +61,10 @@ def _park_as_standby(go_file: str) -> str:
     logger.info(
         "serving standby warmed (pid %d); parking on %s", os.getpid(), go_file
     )
+    from elasticdl_tpu.common import durable
+
     ready = go_file + ".ready"
-    with open(ready + ".tmp", "w") as f:
-        f.write(str(os.getpid()))
-    os.replace(ready + ".tmp", ready)
+    durable.atomic_publish(ready, str(os.getpid()))
     parent0 = os.getppid()
     while not os.path.exists(go_file):
         if os.getppid() != parent0:

@@ -92,10 +92,10 @@ def _park_as_standby(go_file: str) -> str:
     # marker is absent and cold-spawns instead (ProcessPodBackend
     # _adopt_standby), so a burst of failures never queues behind a spare
     # that is still paying its imports.
+    from elasticdl_tpu.common import durable
+
     ready = go_file + ".ready"
-    with open(ready + ".tmp", "w") as f:
-        f.write(str(os.getpid()))
-    os.replace(ready + ".tmp", ready)
+    durable.atomic_publish(ready, str(os.getpid()))
     parent0 = os.getppid()
     while not os.path.exists(go_file):
         if os.getppid() != parent0:

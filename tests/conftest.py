@@ -45,6 +45,15 @@ os.environ.setdefault("GRAFT_RACESAN", "1")
 # may move constants).
 os.environ.setdefault("GRAFT_JITSAN", "1")
 
+# Runtime durability sanitizer (common/crashsan.py) ON for the whole
+# tier-1 suite — the dynamic twin of graftlint's v7 durability passes:
+# every durable-write crossing (common/durable.py append/publish/replace)
+# is counted and indexed per file, so crash_at(op, mode) matrices and the
+# chaos grammar's torn_write faults can target exact crossings.  Recording
+# is one locked counter bump per durable op — noise next to the fsync the
+# op itself pays.  setdefault so GRAFT_CRASHSAN=0 forces it off.
+os.environ.setdefault("GRAFT_CRASHSAN", "1")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,340 @@
+"""crashsan + common/durable: the durable-write shapes and their crash
+recovery contracts (r21).
+
+Four layers, bottom-up:
+
+1. The durable primitives themselves — atomic_publish/append_durable
+   round-trips, thread-unique temp names, short-write loudness, the
+   torn-tail-vs-mid-file-garbage split in read_wal.
+2. crashsan semantics — record() enumeration, crash_at's relative
+   countdown, the GRAFT_CRASHSAN gate (arming with the sanitizer off must
+   fail loud, not silently never crash).
+3. Per-mode on-disk crash states — each crash mode produces exactly the
+   state a real death leaves, and the matching tolerant reader lands in
+   its contract class.
+4. The matrix — tools/crashsan_matrix.py's full sweep in-process (every
+   scenario x op x mode recovers), plus the r18 "membership record in
+   neither file" regression as a named crash point.
+
+Plus the chaos-grammar end: ``torn_write:file=<durable>,op=N`` parse
+checks and an end-to-end fire through a real atomic_publish.
+
+conftest.py arms GRAFT_CRASHSAN=1 for the whole suite; these tests rely
+on it (crash_at refuses to arm otherwise).
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from elasticdl_tpu.chaos import inject as chaos
+from elasticdl_tpu.common import crashsan, durable
+
+
+@pytest.fixture(autouse=True)
+def _clean_sanitizer():
+    """Counters and per-file op indexes are process-global (that is what
+    lets the chaos grammar address 'the Nth op on that file' across a real
+    process lifetime) — so every test starts from zero and leaves no armed
+    crash or chaos plan behind."""
+    crashsan.reset()
+    yield
+    chaos.configure("")
+    crashsan.reset()
+
+
+# -- 1. durable primitives -------------------------------------------------
+
+
+def test_atomic_publish_roundtrip(tmp_path):
+    p = str(tmp_path / "state.json")
+    durable.atomic_publish_json(p, {"v": 1})
+    durable.atomic_publish_json(p, {"v": 2})
+    assert durable.read_json_tolerant(p) == {"v": 2}
+    # the commit leaves no stray temp behind
+    assert [f for f in os.listdir(tmp_path) if ".tmp" in f] == []
+
+
+def test_tmp_path_is_thread_unique(tmp_path):
+    p = str(tmp_path / "f")
+    names = []
+
+    def grab():
+        names.append(durable.tmp_path(p))
+
+    t = threading.Thread(target=grab)
+    t.start()
+    t.join()
+    grab()
+    assert len(set(names)) == 2  # same pid, different tid
+    assert all(f".tmp{os.getpid()}." in n for n in names)
+
+
+def test_append_short_write_fails_loud(tmp_path, monkeypatch):
+    """A cut-short os.write must raise ShortWriteError, not finish the
+    line; the torn prefix on disk then reads as a tolerated crash tail."""
+    p = str(tmp_path / "log.wal")
+    fd = durable.open_append(p)
+    try:
+        durable.append_durable(fd, json.dumps({"n": 1}) + "\n", path=p)
+        real_write = os.write
+        monkeypatch.setattr(
+            os, "write", lambda f, d: real_write(f, d[: len(d) // 2])
+        )
+        with pytest.raises(durable.ShortWriteError):
+            durable.append_durable(fd, json.dumps({"n": 2}) + "\n", path=p)
+        monkeypatch.undo()
+    finally:
+        os.close(fd)
+    records, torn = durable.read_wal(p)
+    assert records == [{"n": 1}]
+    assert torn
+
+
+def test_read_wal_torn_tail_vs_mid_file_garbage(tmp_path):
+    torn_file = str(tmp_path / "torn.wal")
+    with open(torn_file, "wb") as f:
+        f.write(b'{"n": 1}\n{"n": 2}\n{"n": 3')  # crash tail
+    records, torn = durable.read_wal(torn_file)
+    assert records == [{"n": 1}, {"n": 2}]
+    assert torn
+
+    corrupt = str(tmp_path / "corrupt.wal")
+    with open(corrupt, "wb") as f:
+        f.write(b'{"n": 1}\ngarb@ge\n{"n": 3}\n')  # garbage MID-file
+    with pytest.raises(durable.CorruptWalError):
+        durable.read_wal(corrupt)
+
+
+def test_read_json_tolerant_contract(tmp_path):
+    p = str(tmp_path / "m.json")
+    assert durable.read_json_tolerant(p, default={"d": 1}) == {"d": 1}
+    with open(p, "wb") as f:
+        f.write(b'{"step": 10')  # a tear only a non-compliant writer leaves
+    assert durable.read_json_tolerant(p) is None
+    durable.atomic_publish_json(p, {"step": 10})
+    assert durable.read_json_tolerant(p) == {"step": 10}
+
+
+# -- 2. crashsan semantics -------------------------------------------------
+
+
+def test_record_enumerates_crossings(tmp_path):
+    p = str(tmp_path / "reg.json")
+    with crashsan.record() as ops:
+        durable.atomic_publish_json(p, {"v": 1})
+        durable.atomic_publish_json(p, {"v": 2})
+        fd = durable.open_append(str(tmp_path / "log.wal"))
+        try:
+            durable.append_durable(fd, b"x\n", path=str(tmp_path / "log.wal"))
+        finally:
+            os.close(fd)
+    assert [(o["index"], o["kind"]) for o in ops] == [
+        (0, "publish"), (1, "publish"), (2, "append"),
+    ]
+    # the per-file op index is what a chaos plan's op= matches
+    assert [o["file_op"] for o in ops] == [0, 1, 0]
+    assert ops[0]["file"] == "reg.json"
+
+
+def test_crash_at_counts_relative_crossings(tmp_path):
+    p = str(tmp_path / "state.json")
+    durable.atomic_publish_json(p, {"v": 1})  # before arming: not counted
+    with pytest.raises(crashsan.CrashPoint):
+        with crashsan.crash_at(1, "rename_lost"):
+            durable.atomic_publish_json(p, {"v": 2})  # op 0: survives
+            durable.atomic_publish_json(p, {"v": 3})  # op 1: dies
+    assert durable.read_json_tolerant(p) == {"v": 2}
+
+
+def test_arm_requires_sanitizer_enabled(tmp_path, monkeypatch):
+    monkeypatch.setenv("GRAFT_CRASHSAN", "0")
+    with pytest.raises(crashsan.CrashSanError):
+        crashsan.arm(0, "tmp_torn")
+    # disabled note_op is a no-op: nothing counted, nothing recorded
+    with crashsan.record() as ops:
+        durable.atomic_publish_json(str(tmp_path / "f.json"), {})
+    assert ops == []
+    assert crashsan.op_count() == 0
+
+
+def test_arm_rejects_unknown_mode():
+    with pytest.raises(crashsan.CrashSanError):
+        crashsan.arm(0, "torn_sideways")
+
+
+# -- 3. on-disk crash states per mode --------------------------------------
+
+
+#: the staged bytes of the crashed publish, and the torn prefix (half)
+#: crashsan's simulate leaves of them.
+_V2 = json.dumps({"v": 2}).encode("utf-8")
+_V2_TORN = _V2[: len(_V2) // 2]
+
+
+def _publish_then_crash(tmp_path, mode):
+    p = str(tmp_path / "state.json")
+    durable.atomic_publish_json(p, {"v": 1})
+    with pytest.raises(crashsan.CrashPoint):
+        with crashsan.crash_at(0, mode):
+            durable.atomic_publish_json(p, {"v": 2})
+    return p
+
+
+def test_publish_tmp_torn_leaves_previous_version(tmp_path):
+    p = _publish_then_crash(tmp_path, "tmp_torn")
+    assert durable.read_json_tolerant(p) == {"v": 1}
+    torn_tmps = [f for f in os.listdir(tmp_path) if ".tmp" in f]
+    assert len(torn_tmps) == 1  # the torn temp is on disk, never renamed
+    with open(tmp_path / torn_tmps[0], "rb") as f:
+        assert f.read() == _V2_TORN  # half of the staged bytes
+
+
+def test_publish_rename_lost_leaves_previous_version(tmp_path):
+    p = _publish_then_crash(tmp_path, "rename_lost")
+    assert durable.read_json_tolerant(p) == {"v": 1}
+    (tmp,) = [f for f in os.listdir(tmp_path) if ".tmp" in f]
+    with open(tmp_path / tmp, "rb") as f:
+        assert json.loads(f.read()) == {"v": 2}  # complete, never renamed
+
+
+def test_publish_published_torn_reads_as_nothing(tmp_path):
+    """The non-compliant-writer mode: the TARGET itself is torn.  A
+    compliant atomic_publish can never produce this; the tolerant reader
+    must still land in its fallback, not crash or half-parse."""
+    p = _publish_then_crash(tmp_path, "published_torn")
+    with open(p, "rb") as f:
+        assert f.read() == _V2_TORN
+    assert durable.read_json_tolerant(p, default="fallback") == "fallback"
+
+
+def test_append_torn_append_is_a_tolerated_tail(tmp_path):
+    p = str(tmp_path / "log.wal")
+    fd = durable.open_append(p)
+    try:
+        durable.append_durable(fd, json.dumps({"n": 1}) + "\n", path=p)
+        with pytest.raises(crashsan.CrashPoint):
+            with crashsan.crash_at(0, "torn_append"):
+                durable.append_durable(
+                    fd, json.dumps({"n": 2}) + "\n", path=p
+                )
+    finally:
+        os.close(fd)
+    records, torn = durable.read_wal(p)
+    assert records == [{"n": 1}]
+    assert torn
+
+
+def test_append_lost_leaves_exact_prefix(tmp_path):
+    p = str(tmp_path / "log.wal")
+    fd = durable.open_append(p)
+    try:
+        durable.append_durable(fd, json.dumps({"n": 1}) + "\n", path=p)
+        with pytest.raises(crashsan.CrashPoint):
+            with crashsan.crash_at(0, "append_lost"):
+                durable.append_durable(
+                    fd, json.dumps({"n": 2}) + "\n", path=p
+                )
+    finally:
+        os.close(fd)
+    records, torn = durable.read_wal(p)
+    assert records == [{"n": 1}]
+    assert not torn  # the bytes died in the page cache: no tear at all
+
+
+def test_replace_modes(tmp_path):
+    p = str(tmp_path / "cache.bin")
+    durable.atomic_publish(p, b"version-one!")
+    for mode, expect in (
+        ("tmp_torn", b"version-one!"),    # temp torn, target untouched
+        ("rename_lost", b"version-one!"),  # temp complete, never renamed
+    ):
+        tmp = durable.tmp_path(p)
+        with open(tmp, "wb") as f:
+            f.write(b"version-two!")
+        with pytest.raises(crashsan.CrashPoint):
+            with crashsan.crash_at(0, mode):
+                durable.atomic_replace(tmp, p)
+        with open(p, "rb") as f:
+            assert f.read() == expect, mode
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+# -- 4. the matrix ---------------------------------------------------------
+
+
+def test_matrix_every_crash_point_recovers():
+    from tools.crashsan_matrix import run_matrix
+
+    out = run_matrix()
+    s = out["summary"]
+    assert s["unrecovered"] == 0, [
+        r for r in out["rows"] if not r["recovered"]
+    ]
+    assert s["recovered"] == s["injected"]
+    # 7 journal ops + 3 registry publishes + 2 manifest publishes
+    assert s["crash_points"] == 12
+    assert s["injected"] == sum(s["by_scenario"].values())
+    # every contract class is exercised at least once
+    assert set(s["by_contract"]) == {
+        "exact-prefix", "fallback-empty", "previous-version",
+        "watermark-fallback",
+    }
+
+
+@pytest.mark.parametrize("mode", ["rename_lost", "tmp_torn"])
+def test_journal_membership_survives_rotation_crash(tmp_path, mode):
+    """The r18 regression, as a named crash point: a crash DURING rotation
+    (op 4) must leave the membership record (op 3) readable — under the
+    old two-step rotation it could land in NEITHER the new base nor the
+    old WAL."""
+    from tools.crashsan_matrix import journal_expected, run_journal
+
+    records, torn = run_journal(str(tmp_path), crash=(4, mode))
+    assert records == journal_expected(4)
+    assert {"kind": "membership", "version": 7} in records
+    assert not torn
+
+
+# -- 5. the chaos grammar end ----------------------------------------------
+
+
+def test_chaos_torn_write_parse():
+    (f,) = chaos.parse_plan(
+        "torn_write:file=master_journal.wal,op=3,mode=rename_lost"
+    )
+    assert f.kind == "torn_write"
+    assert f.file == "master_journal.wal"
+    assert f.op == 3
+    assert f.mode == "rename_lost"
+
+    with pytest.raises(chaos.ChaosError):  # typo'd mode fails at parse
+        chaos.parse_plan("torn_write:file=x.wal,mode=torn_sideways")
+    with pytest.raises(chaos.ChaosError):  # basename only, never a path
+        chaos.parse_plan("torn_write:file=/var/run/x.wal,op=0")
+    with pytest.raises(chaos.ChaosError):  # a crash point is one op
+        chaos.parse_plan("torn_write:file=x.wal,op=-1")
+    with pytest.raises(chaos.ChaosError):  # rank= could never match
+        chaos.parse_plan("torn_write:file=x.wal,rank=0")
+
+
+def test_chaos_torn_write_fires_through_real_publish(tmp_path, monkeypatch):
+    """End-to-end: a chaos plan addressing 'the 2nd durable op on
+    pod_registry.json' produces the rename_lost state through a REAL
+    atomic_publish and dies with the chaos kill code."""
+    fired = []
+    monkeypatch.setattr(crashsan, "_exit", lambda code: fired.append(code))
+    chaos.configure(
+        "torn_write:file=pod_registry.json,op=1,mode=rename_lost"
+    )
+    p = str(tmp_path / "pod_registry.json")
+    durable.atomic_publish_json(p, {"v": 1})  # op 0: no match
+    # _exit is stubbed to return, so the simulated death falls through to
+    # CrashPoint — letting one test observe both the exit code and halt.
+    with pytest.raises(crashsan.CrashPoint):
+        durable.atomic_publish_json(p, {"v": 2})  # op 1: dies
+    assert fired == [chaos.CHAOS_KILL_EXIT_CODE]
+    assert durable.read_json_tolerant(p) == {"v": 1}

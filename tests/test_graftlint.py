@@ -15,6 +15,10 @@ from elasticdl_tpu.analysis.blocking import BlockingPropagationPass
 from elasticdl_tpu.analysis.collective_shim import CollectiveShimPass
 from elasticdl_tpu.analysis.compat_shim import CompatShimPass
 from elasticdl_tpu.analysis.core import SourceFile, lint_text, run_lint, run_passes
+from elasticdl_tpu.analysis.durability import (
+    DurableWriteDisciplinePass,
+    RecoveryReadDisciplinePass,
+)
 from elasticdl_tpu.analysis.hot_path import HotPathSyncPass
 from elasticdl_tpu.analysis.import_hygiene import ImportHygienePass, module_dependents
 from elasticdl_tpu.analysis.lock_discipline import LockDisciplinePass
@@ -2305,3 +2309,201 @@ def test_declared_sites_harvest():
     # (the trainer-builder shape), marked dynamic since callers may
     # override upward.
     assert sites["m.param"]["budget"] == 3 and sites["m.param"]["dynamic"]
+
+
+# ---- durable-write-discipline (v7) ----
+
+DURABLE_SEEDED = """
+    import json
+    import os
+
+    JOURNAL_FILENAME = "master_journal.wal"  # durable-file
+
+    def persist(directory, rec):
+        path = os.path.join(directory, JOURNAL_FILENAME)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, path)
+"""
+
+DURABLE_CLEAN = """
+    import os
+
+    from elasticdl_tpu.common import durable
+
+    JOURNAL_FILENAME = "master_journal.wal"  # durable-file
+
+    def persist(directory, rec):
+        path = os.path.join(directory, JOURNAL_FILENAME)
+        durable.atomic_publish_json(path, rec)
+"""
+
+
+def test_durable_write_seeded_vs_clean():
+    findings = _lint(DURABLE_SEEDED, [DurableWriteDisciplinePass()])
+    # three independent violations in one hand-rolled publish: the
+    # hand-rolled temp name, the raw write-mode open of the tainted local,
+    # and the raw os.replace.
+    assert _rules(findings) == {"durable-write-discipline"}
+    assert len(findings) == 3
+    assert _lint(DURABLE_CLEAN, [DurableWriteDisciplinePass()]) == []
+
+
+DURABLE_ATTR_SEEDED = """
+    import os
+
+    REGISTRY_FILENAME = "pod_registry.json"  # durable-file
+
+    class Registry:
+        def __init__(self, directory):
+            self._path = os.path.join(directory, REGISTRY_FILENAME)
+
+        def save(self, blob):
+            fd = os.open(self._path, os.O_WRONLY | os.O_CREAT)
+            os.write(fd, blob)
+            os.close(fd)
+"""
+
+
+def test_durable_write_taint_flows_through_self_attr():
+    # The path reaches the write as self._path, assigned from the
+    # constant in __init__: the class-wide attr taint must carry it to
+    # the write-flavored os.open in save().
+    findings = _lint(DURABLE_ATTR_SEEDED, [DurableWriteDisciplinePass()])
+    assert _rules(findings) == {"durable-write-discipline"}
+    assert any("os.open" in f.message for f in findings)
+
+
+def test_hand_rolled_rename_flagged_without_constants():
+    # os.replace/os.rename are unconditional: every rename IS a publish
+    # commit and belongs in durable.py, tainted operands or not.
+    findings = _lint(
+        """
+        import os
+
+        def swap(a, b):
+            os.rename(a, b)
+        """,
+        [DurableWriteDisciplinePass()],
+    )
+    assert _rules(findings) == {"durable-write-discipline"}
+
+
+def test_durable_module_itself_exempt():
+    src = """
+        import os
+
+        def commit(tmp, path):
+            os.replace(tmp, path)
+    """
+    assert (
+        lint_text(
+            textwrap.dedent(src),
+            [DurableWriteDisciplinePass()],
+            path="elasticdl_tpu/common/durable.py",
+        )
+        == []
+    )
+    # the same text anywhere else is a violation
+    assert _lint(src, [DurableWriteDisciplinePass()]) != []
+
+
+def test_durable_write_waiver_and_stale():
+    waived = """
+        import os
+
+        JOURNAL_FILENAME = "j.wal"  # durable-file
+
+        def persist(directory, data):
+            path = os.path.join(directory, JOURNAL_FILENAME)
+            # graftlint: allow[durable-write-discipline] migration staged for next PR
+            with open(path, "w") as f:
+                f.write(data)
+    """
+    assert _lint(waived, [DurableWriteDisciplinePass()]) == []
+    stale = """
+        from elasticdl_tpu.common import durable
+
+        JOURNAL_FILENAME = "j.wal"  # durable-file
+
+        def persist(path, data):
+            # graftlint: allow[durable-write-discipline] nothing here needs this
+            durable.atomic_publish(path, data)
+    """
+    assert _rules(_lint(stale, [DurableWriteDisciplinePass()])) == {
+        "stale-waiver"
+    }
+
+
+# ---- recovery-read-discipline (v7) ----
+
+RECOVERY_SEEDED = """
+    import json
+
+    # recovery-path
+    def load(path):
+        with open(path) as f:
+            return json.load(f)
+"""
+
+RECOVERY_CLEAN = """
+    from elasticdl_tpu.common import durable
+
+    # recovery-path
+    def load(path):
+        records, torn = durable.read_wal(path)
+        return records
+"""
+
+
+def test_recovery_read_seeded_vs_clean():
+    findings = _lint(RECOVERY_SEEDED, [RecoveryReadDisciplinePass()])
+    assert _rules(findings) == {"recovery-read-discipline"}
+    assert _lint(RECOVERY_CLEAN, [RecoveryReadDisciplinePass()]) == []
+
+
+def test_raw_read_of_durable_path_outside_recovery_fn():
+    # Reading a durable file from an UNANNOTATED function is the other
+    # half: crash states (torn tail, non-compliant tear) reach every
+    # reader, so every reader must route through the tolerant API.
+    findings = _lint(
+        """
+        import os
+
+        REGISTRY_FILENAME = "pod_registry.json"  # durable-file
+
+        def peek(directory):
+            path = os.path.join(directory, REGISTRY_FILENAME)
+            with open(path) as f:
+                return f.read()
+        """,
+        [RecoveryReadDisciplinePass()],
+    )
+    assert _rules(findings) == {"recovery-read-discipline"}
+
+
+def test_v7_passes_registered():
+    kinds = {type(p) for p in all_passes()}
+    assert DurableWriteDisciplinePass in kinds
+    assert RecoveryReadDisciplinePass in kinds
+
+
+def test_cli_durables_dump():
+    out = subprocess.run(
+        [
+            sys.executable, "tools/graftlint.py", "elasticdl_tpu", "tools",
+            "--durables",
+        ],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stderr
+    doc = json.loads(out.stdout)
+    assert {
+        "JOURNAL_FILENAME", "MANIFEST_NAME", "METRICS_FILENAME",
+        "PROGRESS_FILENAME", "REGISTRY_FILENAME",
+    } <= set(doc)
+    j = doc["JOURNAL_FILENAME"]
+    assert j["file"] == "master_journal.wal"
+    assert any(w.endswith(" rotate") for w in j["writers"])
+    assert any("read_journal" in r for r in j["recovery_readers"])

@@ -8,7 +8,6 @@ quotes the files; VERDICT r4 Next #5).  One definition so the write idiom
 
 from __future__ import annotations
 
-import json
 import os
 import sys
 import time
@@ -155,24 +154,26 @@ def write_artifact(
         or (os.environ.get(env_var, "") if env_var else "")
         or os.path.join(_REPO_ROOT, "artifacts", default_name)
     )
-    d = os.path.dirname(out)
-    if d:
-        os.makedirs(d, exist_ok=True)
-    with open(out, "w") as f:
-        json.dump(
-            {
-                **result,
-                "command": " ".join(sys.argv),
-                # Which backend the process was aimed at — so a CPU smoke
-                # run can never masquerade as an on-chip number of record.
-                "jax_platforms": os.environ.get(
-                    "JAX_PLATFORMS", "(default: axon tpu)"
-                ),
-                "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-            },
-            f,
-            indent=1,
-        )
+    # Atomic since r21 (durable.atomic_publish): a tool killed mid-stamp
+    # used to leave a truncated JSON file that bench_regress parses as a
+    # corrupt artifact — a number of record must commit whole or not at
+    # all, same as any durable state.
+    from elasticdl_tpu.common import durable
+
+    durable.atomic_publish_json(
+        out,
+        {
+            **result,
+            "command": " ".join(sys.argv),
+            # Which backend the process was aimed at — so a CPU smoke
+            # run can never masquerade as an on-chip number of record.
+            "jax_platforms": os.environ.get(
+                "JAX_PLATFORMS", "(default: axon tpu)"
+            ),
+            "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        },
+        indent=1,
+    )
     say = log or (lambda m: print(m, file=sys.stderr, flush=True))
     say(f"artifact written to {out}")
     return out

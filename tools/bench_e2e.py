@@ -42,9 +42,11 @@ def _dataset(tmp_dir: str = "/tmp") -> str:
     n = RECORDS_PER_TASK * FILE_TASKS
     path = os.path.join(tmp_dir, f"edl_bench_criteo_v{_CACHE_VERSION}_{n}.rio")
     if not os.path.exists(path):
-        tmp = path + ".tmp"
+        from elasticdl_tpu.common import durable
+
+        tmp = durable.tmp_path(path)
         synthetic_criteo(tmp, n, seed=11, container="recordio")
-        os.replace(tmp, path)
+        durable.atomic_replace(tmp, path)
     return path
 
 

@@ -212,6 +212,25 @@ EXTRACTORS = {
     # zero-baseline LOWER rule below.
     "lint_findings": lambda d: {
         "findings": (d.get("findings"), LOWER),
+        # v7 durability series, both zero at every healthy rev: the two
+        # durable-discipline rules' repo-wide finding count, and the
+        # crashsan matrix's unrecovered crash points (a crash state some
+        # recovery reader mishandled).  Any climb off zero gates outright.
+        "durability_findings": (
+            (
+                float((d.get("by_rule") or {}).get(
+                    "durable-write-discipline", 0))
+                + float((d.get("by_rule") or {}).get(
+                    "recovery-read-discipline", 0))
+            ) if isinstance(d.get("by_rule"), dict) else None,
+            LOWER,
+        ),
+        "crashsan_unrecovered": (
+            (((d.get("crashsan") or {}).get("summary")) or {}).get(
+                "unrecovered"
+            ),
+            LOWER,
+        ),
         **{
             f"jit_over_budget[{fn}]": (
                 max(

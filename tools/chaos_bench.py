@@ -639,13 +639,14 @@ def run_masterfail_fleet(
             raise RuntimeError(f"masterfail fleet {label}: master 1 wedged")
         _poll_status(client, box)
         if not worker_pids and os.path.exists(registry_path):
+            from elasticdl_tpu.common import durable
+
+            reg = durable.read_json_tolerant(registry_path, default={})
             try:
-                with open(registry_path) as f:
-                    worker_pids = {
-                        v["name"]: v["pid"]
-                        for v in _json.load(f)["slots"].values()
-                    }
-            except (OSError, ValueError, KeyError):
+                worker_pids = {
+                    v["name"]: v["pid"] for v in reg["slots"].values()
+                }
+            except (KeyError, TypeError, AttributeError):
                 pass
         time.sleep(0.15)
     rc1 = master1.returncode

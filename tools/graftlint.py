@@ -6,6 +6,7 @@ Usage:
     python tools/graftlint.py --json             # findings + waiver inventory
     python tools/graftlint.py --callgraph        # dump the v2 call/lock graph
     python tools/graftlint.py --threadmap        # dump the v5 role map
+    python tools/graftlint.py --durables         # dump the v7 durable inventory
     python tools/graftlint.py --artifact [PATH]  # stamp LINT artifact
     python tools/graftlint.py --list-rules
 
@@ -40,12 +41,17 @@ if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
 DEFAULT_PATHS = ("elasticdl_tpu", "tools")
-ARTIFACT_NAME = "LINT_r18.json"
+ARTIFACT_NAME = "LINT_r21.json"
 
 #: jitsan runtime stats (common/jitsan.py dump, GRAFT_JITSAN_DUMP) merged
 #: into the artifact when present: the static tool stays jax-free, so the
 #: measured compile counts come from a jitsan-armed run's dump file.
 JITSAN_STATS_DEFAULT = os.path.join("artifacts", "jitsan_stats.json")
+
+#: crashsan matrix summary (tools/crashsan_matrix.py) merged into the
+#: artifact when present — same stance as the jitsan dump: the static tool
+#: proves the write routing, the matrix proves the crash states recover.
+CRASHSAN_MATRIX_DEFAULT = os.path.join("artifacts", "crashsan_matrix.json")
 
 
 def _changed_files(repo: str) -> Optional[List[str]]:
@@ -141,6 +147,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         "points) as JSON and exit",
     )
     parser.add_argument(
+        "--durables", action="store_true",
+        help="dump the v7 durable-file inventory (constant -> writers -> "
+        "recovery readers) as JSON and exit",
+    )
+    parser.add_argument(
         "--artifact", nargs="?", const="", default=None, metavar="PATH",
         help="write a LINT artifact (findings + per-rule counts + waiver "
         "inventory + lock-graph/blocking-root stats + code_rev) via "
@@ -217,15 +228,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     waivers = collect_waivers(sources, only_paths=only_paths)
 
-    if args.callgraph or args.threadmap:
+    if args.callgraph or args.threadmap or args.durables:
         # Findings still gate the exit code — render them (stderr, so the
         # stdout JSON stays parseable) or a failing dump is undiagnosable.
         for f in findings:
             print(f.render(), file=sys.stderr)
-        dump = (
-            _callgraph_dump(sources) if args.callgraph
-            else _threadmap_dump(sources)
-        )
+        if args.callgraph:
+            dump = _callgraph_dump(sources)
+        elif args.threadmap:
+            dump = _threadmap_dump(sources)
+        else:
+            from elasticdl_tpu.analysis.durability import durables_inventory
+
+            dump = durables_inventory(sources)
         print(json.dumps(dump, indent=1, sort_keys=True))
         return 1 if findings else 0
 
@@ -302,6 +317,25 @@ def main(argv: Optional[List[str]] = None) -> int:
             jitsan_meta["stale_vs_code"] = (
                 bool(code_s is not None and dumped_s < code_s)
             )
+        # v7 crashsan section: the matrix driver's summary (crash points
+        # injected / recovered / contract class per scenario) when a run
+        # left one (env CRASHSAN_MATRIX overrides the default path).
+        # bench_regress gates crashsan_unrecovered at zero.
+        matrix_path = os.environ.get(
+            "CRASHSAN_MATRIX",
+            os.path.join(_REPO_ROOT, CRASHSAN_MATRIX_DEFAULT),
+        )
+        crashsan_summary = None
+        if os.path.exists(matrix_path):
+            try:
+                with open(matrix_path, encoding="utf-8") as f:
+                    loaded = json.load(f)
+                if isinstance(loaded, dict):
+                    crashsan_summary = loaded.get("summary", loaded)
+            except (OSError, ValueError):
+                pass  # a torn matrix file must not fail the lint artifact
+        from elasticdl_tpu.analysis.durability import durables_inventory
+
         write_artifact(
             {
                 # The trajectory gate (tools/bench_regress.py) indexes
@@ -338,6 +372,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "stats_file": (
                         os.path.relpath(stats_path, _REPO_ROOT)
                         if jitsan_runtime is not None else None
+                    ),
+                },
+                "durables": durables_inventory(sources),
+                "crashsan": {
+                    "summary": crashsan_summary,
+                    "matrix_file": (
+                        os.path.relpath(matrix_path, _REPO_ROOT)
+                        if crashsan_summary is not None else None
                     ),
                 },
                 "thread_map": {

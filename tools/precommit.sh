@@ -9,6 +9,11 @@
 # dependent-module scoping as import-hygiene — edit a '# jit-boundary'
 # helper and every hot-path module that calls it re-lints.
 #
+# So do the v7 durability passes (r21): both are project passes, so they
+# always SEE the whole file set (every '# durable-file' constant resolves
+# even when its declaring module didn't change) while reporting stays
+# scoped to the changed files plus their dependents.
+#
 # Install (from the repo root):
 #     ln -sf ../../tools/precommit.sh .git/hooks/pre-commit
 # or, to keep an existing hook, call this script from it.

@@ -87,10 +87,9 @@ def _spawn_standby(config, log_dir, tag):
 
 
 def _adopt_standby(proc, go_file, worker_id):
-    tmp = go_file + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump({"worker_id": worker_id, "env": {}}, f)
-    os.replace(tmp, go_file)
+    from elasticdl_tpu.common import durable
+
+    durable.atomic_publish_json(go_file, {"worker_id": worker_id, "env": {}})
     return proc
 
 
