@@ -107,6 +107,30 @@ v7 adds durability discipline (``analysis/durability.py``):
                         tolerance window is a declared contract, not an
                         accident
 
+v8 adds wire-schema discipline (``analysis/wire_discipline.py``), built
+on a schema index EVALUATED from the ``MessageSchema`` literals in
+``common/rpc.py`` (the ``*_SCHEMAS`` / ``*_RESPONSE_SCHEMAS`` tables,
+their type-alias tuples, and the ``setdefault`` envelope loops):
+
+- ``wire-discipline``   sender payload dicts flowing into ``.call`` /
+                        ``.call_async`` sites may not carry undeclared
+                        keys (the receiver drops unknown fields —
+                        additive-compat — so the data silently
+                        vanishes); receiver handlers (resolved via the
+                        thread_map ``method_table`` machinery plus the
+                        serving tier's dict-literal wiring, with
+                        same-file helper propagation) and client
+                        response locals may not subscript an OPTIONAL
+                        field (old peers omit it; ``.get()`` required)
+                        nor read undeclared fields
+- ``wire-evolution``    removing a field, changing its accepted types,
+                        or adding a REQUIRED field to an existing
+                        method is a finding against the committed
+                        fingerprint ``artifacts/wire_schema.lock.json``
+                        unless PROTOCOL_VERSION is bumped and the lock
+                        regenerated (``--update-wire-lock``) in the
+                        same diff; additive drift just regenerates
+
 The runtime twin of ``lock-order`` is ``common/locksan.py``: a debug lock
 wrapper that records actual acquisition orders under ``GRAFT_LOCKSAN=1``
 (on for tier-1 via tests/conftest.py) and raises on inversions or
@@ -118,7 +142,13 @@ cross-role unguarded write.  The durability rules' runtime twin is
 ``common/crashsan.py`` (``GRAFT_CRASHSAN=1``, tier-1-wide): every
 durable-write crossing is indexed, and ``crash_at(op, mode)`` forges the
 exact on-disk state a crash at that point leaves so the recovery readers
-are driven through every injectable crash point.
+are driven through every injectable crash point.  The wire rules' twin is
+``common/wiresan.py`` (``GRAFT_WIRESAN=1``, tier-1-wide): every request
+AND response crossing ``JsonRpcClient.call`` / ``make_generic_handler``
+is validated against its schema, unknown fields are counted per method
+(``edl_wire_unknown_fields_total``), and ``GRAFT_WIRESAN_MASK=<rev>``
+emulates an old peer by stripping newer-than-``rev`` fields — the
+version-skew roundtrip ``tools/wire_skew.py`` stamps into the artifact.
 
 Inline waivers: ``# graftlint: allow[<rule>] <reason>`` — the reason is
 mandatory; malformed waivers are themselves findings (``waiver-syntax``).
@@ -157,6 +187,10 @@ from elasticdl_tpu.analysis.rpc_discipline import RpcDisciplinePass
 from elasticdl_tpu.analysis.shared_state import SharedStatePass
 from elasticdl_tpu.analysis.thread_hygiene import ThreadHygienePass
 from elasticdl_tpu.analysis.trace_discipline import TraceDisciplinePass
+from elasticdl_tpu.analysis.wire_discipline import (
+    WireDisciplinePass,
+    WireEvolutionPass,
+)
 
 
 def all_passes() -> list:
@@ -181,4 +215,6 @@ def all_passes() -> list:
         TransferDisciplinePass(),
         DurableWriteDisciplinePass(),
         RecoveryReadDisciplinePass(),
+        WireDisciplinePass(),
+        WireEvolutionPass(),
     ]

@@ -67,6 +67,14 @@ KNOWN_RULES = {
     # readers.  Runtime twin: common/crashsan.py.
     "durable-write-discipline",
     "recovery-read-discipline",
+    # v8: wire-schema discipline (analysis/wire_discipline.py) — sender
+    # payloads carry only MessageSchema-declared keys, receiver handlers
+    # and client response reads never subscript OPTIONAL fields, and
+    # breaking schema drift against artifacts/wire_schema.lock.json needs
+    # a PROTOCOL_VERSION bump + regenerated lock in the same diff.
+    # Runtime twin: common/wiresan.py.
+    "wire-discipline",
+    "wire-evolution",
     # A waiver that suppresses no finding is itself a finding: the waiver
     # inventory must not rot as code moves (see run_passes).
     "stale-waiver",

@@ -1,0 +1,951 @@
+"""Wire-schema discipline (v8): both ends of every RPC match the schema.
+
+The JSON-over-gRPC control plane's compatibility contract — "additive
+optional field, no PROTOCOL_VERSION bump", the r9/r12/r14/r18 stance —
+lived in comments in ``common/rpc.py`` and reviewer vigilance.  These
+two rules make it machine-checked before the RPC surface grows again
+(the elastic PS tier and the train-to-serve loop both will), in the
+established static-pass + runtime-sanitizer lineage (v5+racesan,
+v6+jitsan, v7+crashsan; the runtime twin here is ``common/wiresan.py``):
+
+- ``wire-discipline``
+    The schema index is EVALUATED from the ``MessageSchema`` literals in
+    ``common/rpc.py`` — the ``*_SCHEMAS`` table assignments (request
+    tables; ``*_RESPONSE_SCHEMAS`` are response tables), the type-alias
+    tuples they reference, and the ``setdefault`` envelope loops that
+    splice trace/phase_counts/gauge onto methods after the literals.
+    Both sides of every method are then judged:
+
+    * SENDERS — a payload dict flowing into a ``.call``/``.call_async``
+      site whose method name the index knows may not carry an undeclared
+      key: the receiver validates-then-ignores unknown fields (the
+      additive-compat stance), so a misspelled or undeclared key is a
+      silently dropped field — a latent protocol bug.  Payloads resolve
+      from inline dict literals and from locals assigned a dict literal
+      then grown via ``p["k"] = v`` / ``p.update({...})`` /
+      ``p.setdefault("k", v)``; dynamically built payloads are skipped
+      (wiresan covers them at runtime).
+    * RECEIVERS — handler functions (resolved via the thread_map
+      ``method_table`` machinery, plus the serving tier's
+      ``{"Method": self._handler}`` dict-literal wiring) may not
+      subscript-access an OPTIONAL field (``msg["gauge"]`` is a finding:
+      old peers omit it — ``.get()`` required) nor read an undeclared
+      one.  The message parameter's methods propagate through bare-name
+      helper calls in the same file (``self._record_gauges(req)``): a
+      subscript is legal only for a field REQUIRED in EVERY method
+      flowing into that scope; a ``.get`` is legal for a field declared
+      in AT LEAST ONE (mixed-method helpers branch on what arrived).
+    * CLIENT RESPONSES — a local assigned from a ``.call`` whose method
+      has a response schema is judged by the same grammar against that
+      schema: subscripting an optional/undeclared response field is how
+      an old master turns into a worker KeyError.
+
+- ``wire-evolution``
+    Cross-version compatibility, enforced statically against the
+    committed fingerprint ``artifacts/wire_schema.lock.json``: removing
+    a field, changing a field's accepted types, or adding a REQUIRED
+    field to an existing method is a finding unless PROTOCOL_VERSION is
+    bumped AND the lock regenerated (``tools/graftlint.py
+    --update-wire-lock``) in the same diff.  Additive drift (a new
+    optional field, a new method, a ``since`` stamp) just asks for the
+    lock to be regenerated.  A version bump with a regenerated lock is
+    clean by construction — the lock IS the reviewed record of the new
+    baseline.
+
+Blind spots (wiresan covers them at runtime): payloads built
+dynamically (comprehensions, ``dict(**x)``, cross-function
+construction), response dicts threaded through helper returns, and the
+PS tier's binary ``call(method, meta, arrays)`` frames — their method
+names are outside the index, so both rules skip them by construction.
+
+Waive with ``# graftlint: allow[<rule>] <reason>`` on the finding's
+line or a comment-only line above.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from elasticdl_tpu.analysis.core import Finding, LintPass, SourceFile, attr_chain
+from elasticdl_tpu.analysis.import_hygiene import _module_name
+from elasticdl_tpu.analysis.thread_map import shared_thread_map
+
+#: The committed schema fingerprint the wire-evolution rule judges
+#: against (regenerate with ``tools/graftlint.py --update-wire-lock``).
+WIRE_LOCK_PATH = "artifacts/wire_schema.lock.json"
+
+#: The JSON-wire type vocabulary a schema tuple may spell.
+_WIRE_TYPES = {"str", "int", "float", "bool", "dict", "list", "tuple"}
+
+
+def _const_str(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+# -- schema-index evaluation -------------------------------------------------
+
+
+def _eval_types(node, aliases: Dict[str, Tuple[str, ...]]) -> Optional[Tuple[str, ...]]:
+    """A field's accepted-types expression -> sorted type-name tuple:
+    an alias Name (``_NUM``), or an inline tuple of builtin type names
+    (``(list, dict)``)."""
+    if isinstance(node, ast.Name):
+        return aliases.get(node.id)
+    if isinstance(node, ast.Tuple):
+        names: List[str] = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Name) and elt.id in _WIRE_TYPES:
+                names.append(elt.id)
+            else:
+                return None
+        return tuple(sorted(names))
+    return None
+
+
+def _eval_field_dict(
+    node, aliases: Dict[str, Tuple[str, ...]]
+) -> Optional[Dict[str, Tuple[str, ...]]]:
+    if not isinstance(node, ast.Dict):
+        return None
+    out: Dict[str, Tuple[str, ...]] = {}
+    for key, value in zip(node.keys, node.values):
+        field = _const_str(key)
+        types = _eval_types(value, aliases)
+        if field is None or types is None:
+            return None
+        out[field] = types
+    return out
+
+
+def _eval_since_dict(node) -> Optional[Dict[str, int]]:
+    if not isinstance(node, ast.Dict):
+        return None
+    out: Dict[str, int] = {}
+    for key, value in zip(node.keys, node.values):
+        field = _const_str(key)
+        if field is None or not (
+            isinstance(value, ast.Constant) and isinstance(value.value, int)
+        ):
+            return None
+        out[field] = value.value
+    return out
+
+
+class _SchemaRec:
+    """One method's evaluated schema (one wire direction)."""
+
+    def __init__(self, path: str, line: int):
+        self.path = path
+        self.line = line
+        self.required: Dict[str, Tuple[str, ...]] = {}
+        self.optional: Dict[str, Tuple[str, ...]] = {}
+        self.since: Dict[str, int] = {}
+
+    @property
+    def declared(self) -> Set[str]:
+        return set(self.required) | set(self.optional)
+
+    def as_dict(self) -> dict:
+        return {
+            "required": {f: list(t) for f, t in sorted(self.required.items())},
+            "optional": {f: list(t) for f, t in sorted(self.optional.items())},
+            "since": dict(sorted(self.since.items())),
+        }
+
+
+class SchemaIndex:
+    """The evaluated wire contract: request + response schemas per
+    method, plus the declaring table locations and PROTOCOL_VERSION."""
+
+    def __init__(self):
+        self.request: Dict[str, _SchemaRec] = {}
+        self.response: Dict[str, _SchemaRec] = {}
+        #: (path, line) of the first schema-table assignment seen — the
+        #: anchor for table-level wire-evolution findings.
+        self.decl: Optional[Tuple[str, int]] = None
+        self.protocol_version: Optional[int] = None
+
+    def direction(self, name: str) -> Dict[str, _SchemaRec]:
+        return self.response if "RESPONSE" in name else self.request
+
+
+def _is_schema_call(node) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    name = f.id if isinstance(f, ast.Name) else (
+        f.attr if isinstance(f, ast.Attribute) else ""
+    )
+    return name == "MessageSchema"
+
+
+def _eval_schema_call(
+    node: ast.Call, aliases, path: str
+) -> Optional[_SchemaRec]:
+    rec = _SchemaRec(path, node.lineno)
+    sections = {}
+    for i, arg in enumerate(node.args):
+        sections[("required", "optional", "since")[i] if i < 3 else f"arg{i}"] = arg
+    for kw in node.keywords:
+        sections[kw.arg] = kw.value
+    for name, value in sections.items():
+        if name == "since":
+            since = _eval_since_dict(value)
+            if since is None:
+                return None
+            rec.since = since
+        elif name in ("required", "optional"):
+            fields = _eval_field_dict(value, aliases)
+            if fields is None:
+                return None
+            setattr(rec, name, fields)
+        else:
+            return None
+    return rec
+
+
+def collect_schema_index(sources: Sequence[SourceFile]) -> SchemaIndex:
+    """Evaluate every ``*_SCHEMAS`` table literal (requests;
+    ``*_RESPONSE_SCHEMAS`` are responses), the type aliases they
+    reference, the ``setdefault`` envelope loops that splice fields onto
+    already-declared methods, and PROTOCOL_VERSION."""
+    idx = SchemaIndex()
+    for src in sources:
+        aliases: Dict[str, Tuple[str, ...]] = {}
+        tables: Dict[str, Dict[str, _SchemaRec]] = {}
+        declared_any = False
+        for node in src.tree.body:
+            target, value = None, None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target, value = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                target, value = node.target, node.value
+            if isinstance(target, ast.Name) and value is not None:
+                types = _eval_types(value, aliases)
+                if types is not None:
+                    aliases[target.id] = types
+                    continue
+                if (
+                    target.id == "PROTOCOL_VERSION"
+                    and isinstance(value, ast.Constant)
+                    and isinstance(value.value, int)
+                ):
+                    idx.protocol_version = value.value
+                    continue
+                if target.id.endswith("_SCHEMAS") and isinstance(value, ast.Dict):
+                    table = idx.direction(target.id)
+                    local: Dict[str, _SchemaRec] = {}
+                    for key, call in zip(value.keys, value.values):
+                        method = _const_str(key)
+                        if method is None or not _is_schema_call(call):
+                            continue
+                        rec = _eval_schema_call(call, aliases, src.path)
+                        if rec is not None:
+                            table[method] = rec
+                            local[method] = rec
+                    if local:
+                        declared_any = True
+                        tables[target.id] = local
+                        if idx.decl is None:
+                            idx.decl = (src.path, node.lineno)
+                    continue
+            if isinstance(node, ast.For) and declared_any:
+                _apply_envelope_loop(node, tables, aliases)
+    return idx
+
+
+def _apply_envelope_loop(
+    loop: ast.For,
+    tables: Dict[str, Dict[str, _SchemaRec]],
+    aliases: Dict[str, Tuple[str, ...]],
+) -> None:
+    """The two envelope-loop shapes ``common/rpc.py`` uses:
+
+    ``for v in TABLE.values(): v.<section>.setdefault(key, val)``
+        splices onto EVERY method of TABLE;
+    ``for v in ("A", "B"): TABLE[v].<section>.setdefault(key, val)``
+        splices onto the listed methods.
+    """
+    if not isinstance(loop.target, ast.Name):
+        return
+    var = loop.target.id
+    targets: List[_SchemaRec] = []
+    it = loop.iter
+    if (
+        isinstance(it, ast.Call)
+        and isinstance(it.func, ast.Attribute)
+        and it.func.attr == "values"
+        and isinstance(it.func.value, ast.Name)
+        and it.func.value.id in tables
+    ):
+        targets = list(tables[it.func.value.id].values())
+        subscript_form = False
+    elif isinstance(it, (ast.Tuple, ast.List)):
+        methods = [_const_str(e) for e in it.elts]
+        if any(m is None for m in methods):
+            return
+        subscript_form = True
+    else:
+        return
+    for stmt in loop.body:
+        if not (isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call)):
+            continue
+        call = stmt.value
+        f = call.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "setdefault"):
+            continue
+        section_attr = f.value
+        if not isinstance(section_attr, ast.Attribute):
+            continue
+        section = section_attr.attr
+        if section not in ("required", "optional", "since"):
+            continue
+        recv = section_attr.value
+        recs: List[_SchemaRec]
+        if not subscript_form:
+            if not (isinstance(recv, ast.Name) and recv.id == var):
+                continue
+            recs = targets
+        else:
+            if not (
+                isinstance(recv, ast.Subscript)
+                and isinstance(recv.value, ast.Name)
+                and recv.value.id in tables
+            ):
+                continue
+            sl = recv.slice
+            if isinstance(sl, ast.Index):  # pragma: no cover — py<3.9 shape
+                sl = sl.value
+            if not (isinstance(sl, ast.Name) and sl.id == var):
+                continue
+            table = tables[recv.value.id]
+            recs = [table[m] for m in methods if m in table]
+        if len(call.args) < 2:
+            continue
+        key = _const_str(call.args[0])
+        if key is None:
+            continue
+        if section == "since":
+            v = call.args[1]
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                for rec in recs:
+                    rec.since.setdefault(key, v.value)
+        else:
+            types = _eval_types(call.args[1], aliases)
+            if types is not None:
+                for rec in recs:
+                    getattr(rec, section).setdefault(key, types)
+
+
+def wire_fingerprint(sources: Sequence[SourceFile]) -> dict:
+    """The lock-file payload: protocol version + every method's evaluated
+    schema, both directions, keyed ``"<direction>:<method>"``."""
+    idx = collect_schema_index(sources)
+    methods = {}
+    for direction, table in (("request", idx.request), ("response", idx.response)):
+        for method, rec in table.items():
+            methods[f"{direction}:{method}"] = rec.as_dict()
+    return {
+        "protocol_version": idx.protocol_version,
+        "methods": {k: methods[k] for k in sorted(methods)},
+    }
+
+
+# -- the sender / receiver / response model ----------------------------------
+
+
+def _scope_nodes(body) -> Iterable[ast.AST]:
+    """Every node under ``body``, pruning nested def scopes but KEEPING
+    lambdas — ``call_with_backoff(lambda: c.call(...))`` is this
+    function's wire traffic and the lambda shares its locals."""
+    stack: List[ast.AST] = list(body)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _iter_functions(src: SourceFile):
+    """``(fn, class_name)`` for every function/method, nested included."""
+    def walk(body, cls_name):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node, cls_name
+                yield from walk(node.body, cls_name)
+            elif isinstance(node, ast.ClassDef):
+                yield from walk(node.body, node.name)
+            elif isinstance(node, (ast.If, ast.Try, ast.With, ast.For, ast.While)):
+                yield from walk(
+                    getattr(node, "body", [])
+                    + getattr(node, "orelse", [])
+                    + getattr(node, "finalbody", []),
+                    cls_name,
+                )
+    yield from walk(src.tree.body, None)
+
+
+def _call_method_name(node: ast.Call) -> Optional[str]:
+    """The wire method of a ``<recv>.call("M", payload)`` /
+    ``.call_async`` site; None for other calls (including the PS tier's
+    no-payload forms — those are judged only when a payload arg exists)."""
+    f = node.func
+    if not (isinstance(f, ast.Attribute) and f.attr in ("call", "call_async")):
+        return None
+    if len(node.args) < 2:
+        return None
+    return _const_str(node.args[0])
+
+
+class _PayloadTracker:
+    """Per-function dict-literal payload locals: name -> (keys, live).
+    A local stays judged only while every mutation stays literal; any
+    dynamic growth (``p[var] = ...``, ``p.update(x)``, reassignment from
+    a non-literal) drops it — skipped, never guessed."""
+
+    def __init__(self, fn):
+        self.keys: Dict[str, Set[str]] = {}
+        dead: Set[str] = set()
+        for n in _scope_nodes(fn.body):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 and isinstance(
+                n.targets[0], ast.Name
+            ):
+                name = n.targets[0].id
+                lit = self._literal_keys(n.value)
+                if lit is None:
+                    if name in self.keys or isinstance(n.value, ast.Dict):
+                        dead.add(name)
+                else:
+                    if name in self.keys:
+                        self.keys[name] |= lit
+                    else:
+                        self.keys[name] = set(lit)
+            elif isinstance(n, ast.Assign) and len(n.targets) == 1 and isinstance(
+                n.targets[0], ast.Subscript
+            ):
+                sub = n.targets[0]
+                if isinstance(sub.value, ast.Name) and sub.value.id in self.keys:
+                    sl = sub.slice
+                    if isinstance(sl, ast.Index):  # pragma: no cover
+                        sl = sl.value
+                    key = _const_str(sl)
+                    if key is None:
+                        dead.add(sub.value.id)
+                    else:
+                        self.keys[sub.value.id].add(key)
+            elif isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+                recv = n.func.value
+                if not (isinstance(recv, ast.Name) and recv.id in self.keys):
+                    continue
+                if n.func.attr == "update":
+                    lit = self._literal_keys(n.args[0]) if n.args else None
+                    if lit is None:
+                        dead.add(recv.id)
+                    else:
+                        self.keys[recv.id] |= lit
+                elif n.func.attr == "setdefault" and n.args:
+                    key = _const_str(n.args[0])
+                    if key is None:
+                        dead.add(recv.id)
+                    else:
+                        self.keys[recv.id].add(key)
+        for name in dead:
+            self.keys.pop(name, None)
+
+    @staticmethod
+    def _literal_keys(node) -> Optional[Set[str]]:
+        """Keys of a dict literal; None when not a fully-literal dict
+        (a ``**spread`` or computed key makes the key set unknowable)."""
+        if not isinstance(node, ast.Dict):
+            return None
+        keys: Set[str] = set()
+        for k in node.keys:
+            s = _const_str(k)
+            if s is None:
+                return None
+            keys.add(s)
+        return keys
+
+    def resolve(self, node) -> Optional[Set[str]]:
+        """The judged key set of a payload argument expression."""
+        if isinstance(node, ast.Dict):
+            # Judge the literal keys even when a **spread rides along —
+            # the spread's keys are unknown, the named ones are not.
+            return {
+                s for s in (_const_str(k) for k in node.keys) if s is not None
+            }
+        if isinstance(node, ast.Name):
+            return self.keys.get(node.id)
+        return None
+
+
+class WireModel:
+    """The whole-project wire view both v8 rules and the ``--wire``
+    inventory read: the schema index, every resolvable sender site,
+    every receiver handler (with helper propagation), and every tracked
+    client response local."""
+
+    def __init__(self, files: Sequence[SourceFile]):
+        self.files = files
+        self.index = collect_schema_index(files)
+        #: method -> ["path:line", ...]
+        self.senders: Dict[str, List[str]] = {}
+        self.receivers: Dict[str, List[str]] = {}
+        self.findings: List[Finding] = []
+        if self.index.request or self.index.response:
+            self._judge_senders_and_responses()
+            self._judge_receivers()
+
+    # -- senders + client responses --
+
+    def _judge_senders_and_responses(self) -> None:
+        req_idx, resp_idx = self.index.request, self.index.response
+        for src in self.files:
+            for fn, _cls in _iter_functions(src):
+                tracker = None  # built lazily — most functions have no wire calls
+                resp_locals: Dict[str, Set[str]] = {}
+                dead_resp: Set[str] = set()
+                for n in _scope_nodes(fn.body):
+                    if isinstance(n, ast.Assign) and len(n.targets) == 1 and isinstance(
+                        n.targets[0], ast.Name
+                    ):
+                        name = n.targets[0].id
+                        m = (
+                            _call_method_name(n.value)
+                            if isinstance(n.value, ast.Call) else None
+                        )
+                        if m is not None and m in resp_idx:
+                            resp_locals.setdefault(name, set()).add(m)
+                        elif name in resp_locals:
+                            dead_resp.add(name)
+                    if not isinstance(n, ast.Call):
+                        continue
+                    method = _call_method_name(n)
+                    if method is None or method not in req_idx:
+                        continue
+                    self.senders.setdefault(method, []).append(
+                        f"{src.path}:{n.lineno}"
+                    )
+                    if tracker is None:
+                        tracker = _PayloadTracker(fn)
+                    keys = tracker.resolve(n.args[1])
+                    if keys is None:
+                        continue
+                    schema = req_idx[method]
+                    undeclared = sorted(keys - schema.declared)
+                    if undeclared:
+                        self.findings.append(Finding(
+                            "wire-discipline", src.path, n.lineno,
+                            f"payload for {method} carries undeclared "
+                            f"key(s) {', '.join(map(repr, undeclared))} — "
+                            "the receiver ignores unknown fields "
+                            "(additive-compat), so the data is silently "
+                            "dropped; declare the field in the "
+                            f"MessageSchema or drop it",
+                        ))
+                for name in dead_resp:
+                    resp_locals.pop(name, None)
+                if resp_locals:
+                    self._judge_reads(
+                        src, fn, resp_locals, resp_idx, kind="response"
+                    )
+                # Direct subscript on the call result itself:
+                # ``c.call("M", {})["field"]``.
+                for n in _scope_nodes(fn.body):
+                    if not (
+                        isinstance(n, ast.Subscript)
+                        and isinstance(n.value, ast.Call)
+                    ):
+                        continue
+                    m = _call_method_name(n.value)
+                    if m is None or m not in resp_idx:
+                        continue
+                    sl = n.slice
+                    if isinstance(sl, ast.Index):  # pragma: no cover
+                        sl = sl.value
+                    field = _const_str(sl)
+                    if field is not None:
+                        self._judge_subscript(
+                            src, n.lineno, field, {m}, resp_idx, "response"
+                        )
+
+    # -- receivers --
+
+    def _judge_receivers(self) -> None:
+        handlers = self._resolve_handlers()
+        # Per-file fixpoint: propagate each handler's message param into
+        # same-file helpers called with the bare param name.
+        by_path = {s.path: s for s in self.files}
+        for path, fn_methods in handlers.items():
+            src = by_path[path]
+            marked = dict(fn_methods)  # (fn, cls) -> {param: methods}
+            fn_index: Dict[Tuple[Optional[str], str], Tuple[ast.AST, Optional[str]]] = {}
+            for fn, cls in _iter_functions(src):
+                fn_index.setdefault((cls, fn.name), (fn, cls))
+                fn_index.setdefault((None, fn.name), (fn, cls))
+            changed = True
+            while changed:
+                changed = False
+                for (fn, cls), params in list(marked.items()):
+                    for n in _scope_nodes(fn.body):
+                        if not isinstance(n, ast.Call):
+                            continue
+                        callee = self._local_callee(n, cls, fn_index)
+                        if callee is None:
+                            continue
+                        cfn, ccls = callee
+                        cparams = [a.arg for a in cfn.args.args]
+                        if cparams and cparams[0] == "self":
+                            cparams = cparams[1:]
+                        for pos, arg in enumerate(n.args):
+                            if not (
+                                isinstance(arg, ast.Name)
+                                and arg.id in params
+                                and pos < len(cparams)
+                            ):
+                                continue
+                            slot = marked.setdefault((cfn, ccls), {})
+                            have = slot.setdefault(cparams[pos], set())
+                            if not params[arg.id] <= have:
+                                have |= params[arg.id]
+                                changed = True
+            for (fn, _cls), params in marked.items():
+                self._judge_reads(src, fn, params, self.index.request,
+                                  kind="request")
+
+    def _local_callee(self, call: ast.Call, cls: Optional[str], fn_index):
+        f = call.func
+        if (
+            isinstance(f, ast.Attribute)
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "self"
+        ):
+            return fn_index.get((cls, f.attr))
+        if isinstance(f, ast.Name):
+            hit = fn_index.get((None, f.id))
+            # Bare-name resolution must not confuse a module function
+            # with a method of an unrelated class.
+            if hit is not None and hit[1] is None:
+                return hit
+        return None
+
+    def _resolve_handlers(self):
+        """path -> {(fn_node, cls_name): {param: {methods}}} for every
+        receiver handler the project wires."""
+        req_idx = self.index.request
+        out: Dict[str, Dict[Tuple[ast.AST, Optional[str]], Dict[str, Set[str]]]] = {}
+        by_mod: Dict[str, SourceFile] = {}
+        meth_nodes: Dict[Tuple[str, str, str], ast.AST] = {}
+        for src in self.files:
+            mod = _module_name(src.path) or src.path
+            by_mod[mod] = src
+            for fn, cls in _iter_functions(src):
+                if cls is not None:
+                    meth_nodes[(mod, cls, fn.name)] = fn
+
+        def mark(src: SourceFile, fn, cls: Optional[str], method: str) -> None:
+            params = [a.arg for a in fn.args.args]
+            if params and params[0] == "self":
+                params = params[1:]
+            if not params:
+                return
+            self.receivers.setdefault(method, []).append(
+                f"{src.path}:{fn.lineno} {fn.name}"
+            )
+            slot = out.setdefault(src.path, {}).setdefault((fn, cls), {})
+            slot.setdefault(params[0], set()).add(method)
+
+        # The method_table string-constant form, via the existing
+        # thread_map machinery (wire name == handler method name there).
+        for e in shared_thread_map(self.files).entries:
+            if e.kind != "grpc" or ":" not in e.target:
+                continue
+            mod, qual = e.target.split(":", 1)
+            if "." not in qual:
+                continue
+            cls, meth = qual.rsplit(".", 1)
+            if meth not in req_idx:
+                continue
+            fn = meth_nodes.get((mod, cls, meth))
+            src = by_mod.get(mod)
+            if fn is not None and src is not None:
+                mark(src, fn, cls, meth)
+        # The dict-literal form ({"Predict": self._predict}) — thread_map
+        # records the handler but loses the WIRE name key, so the wire
+        # mapping is recovered here: a dict literal whose string keys are
+        # all schema methods and whose values are methods of the class.
+        for src in self.files:
+            mod = _module_name(src.path) or src.path
+            for node in src.tree.body:
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                for sub in ast.walk(node):
+                    if not isinstance(sub, ast.Dict) or not sub.keys:
+                        continue
+                    pairs = []
+                    for key, value in zip(sub.keys, sub.values):
+                        method = _const_str(key)
+                        if method is None or method not in req_idx:
+                            pairs = None
+                            break
+                        if not (
+                            isinstance(value, ast.Attribute)
+                            and isinstance(value.value, ast.Name)
+                            and value.value.id == "self"
+                        ):
+                            pairs = None
+                            break
+                        fn = meth_nodes.get((mod, node.name, value.attr))
+                        if fn is None:
+                            pairs = None
+                            break
+                        pairs.append((method, fn))
+                    if pairs:
+                        for method, fn in pairs:
+                            already = self.receivers.get(method, [])
+                            tag = f"{src.path}:{fn.lineno} {fn.name}"
+                            if tag not in already:
+                                mark(src, fn, node.name, method)
+        return out
+
+    # -- the shared read grammar --
+
+    def _judge_reads(
+        self,
+        src: SourceFile,
+        fn,
+        params: Dict[str, Set[str]],
+        idx: Dict[str, _SchemaRec],
+        kind: str,
+    ) -> None:
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Subscript) and isinstance(n.value, ast.Name):
+                name = n.value.id
+                if name not in params or not isinstance(n.ctx, ast.Load):
+                    continue
+                sl = n.slice
+                if isinstance(sl, ast.Index):  # pragma: no cover
+                    sl = sl.value
+                field = _const_str(sl)
+                if field is not None:
+                    self._judge_subscript(
+                        src, n.lineno, field, params[name], idx, kind
+                    )
+            elif (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "get"
+                and isinstance(n.func.value, ast.Name)
+                and n.func.value.id in params
+                and n.args
+            ):
+                field = _const_str(n.args[0])
+                if field is None:
+                    continue
+                methods = params[n.func.value.id]
+                known = {m for m in methods if m in idx}
+                if known and not any(
+                    field in idx[m].declared for m in known
+                ):
+                    self.findings.append(Finding(
+                        "wire-discipline", src.path, n.lineno,
+                        f"reads undeclared {kind} field {field!r} — not in "
+                        f"the schema of {self._fmt(known)}; declare it or "
+                        "drop the read",
+                    ))
+
+    def _judge_subscript(
+        self, src, line: int, field: str, methods: Set[str],
+        idx: Dict[str, _SchemaRec], kind: str,
+    ) -> None:
+        known = {m for m in methods if m in idx}
+        if not known:
+            return
+        if all(field in idx[m].required for m in known):
+            return
+        optional_somewhere = any(field in idx[m].declared for m in known)
+        if optional_somewhere:
+            self.findings.append(Finding(
+                "wire-discipline", src.path, line,
+                f"subscript of OPTIONAL {kind} field {field!r} "
+                f"({self._fmt(known)}) — old peers omit it, so this is a "
+                "version-skew KeyError; use .get()",
+            ))
+        else:
+            self.findings.append(Finding(
+                "wire-discipline", src.path, line,
+                f"reads undeclared {kind} field {field!r} — not in the "
+                f"schema of {self._fmt(known)}; declare it or drop the "
+                "read",
+            ))
+
+    @staticmethod
+    def _fmt(methods: Set[str]) -> str:
+        return "/".join(sorted(methods))
+
+
+class WireDisciplinePass(LintPass):
+    name = "wire-discipline"
+    description = (
+        "sender payloads carry only declared fields; receiver handlers "
+        "and client response reads never subscript optional fields"
+    )
+
+    def run_project(self, files: Sequence[SourceFile]) -> Iterable[Finding]:
+        return WireModel(files).findings
+
+
+class WireEvolutionPass(LintPass):
+    name = "wire-evolution"
+    description = (
+        "schema changes against artifacts/wire_schema.lock.json: breaking "
+        "drift needs a PROTOCOL_VERSION bump + regenerated lock"
+    )
+
+    def __init__(
+        self,
+        lock_path: str = WIRE_LOCK_PATH,
+        lock_data: Optional[dict] = None,
+    ):
+        self._lock_path = lock_path
+        self._lock_data = lock_data
+
+    def _load_lock(self) -> Optional[dict]:
+        if self._lock_data is not None:
+            return self._lock_data
+        path = self._lock_path
+        if not os.path.isabs(path) and not os.path.exists(path):
+            # The default path is repo-relative; the linter may run from
+            # any CWD (pytest, an IDE) — fall back to the repo root this
+            # package lives in.
+            repo = os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)
+            )))
+            path = os.path.join(repo, self._lock_path)
+        try:
+            with open(path, encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def run_project(self, files: Sequence[SourceFile]) -> Iterable[Finding]:
+        current = wire_fingerprint(files)
+        if not current["methods"]:
+            return ()  # no wire surface in this file set — nothing to judge
+        idx = collect_schema_index(files)
+        anchor_path, anchor_line = idx.decl
+        lock = self._load_lock()
+        findings: List[Finding] = []
+        if lock is None:
+            return [Finding(
+                self.name, anchor_path, anchor_line,
+                "no readable wire-schema lock at "
+                f"{self._lock_path} — commit one via tools/graftlint.py "
+                "--update-wire-lock",
+            )]
+        if lock == current:
+            return ()
+        if lock.get("protocol_version") != current["protocol_version"]:
+            # A bump declares a new baseline; the only requirement left
+            # is that the lock records it (regenerated in the same diff).
+            return [Finding(
+                self.name, anchor_path, anchor_line,
+                f"PROTOCOL_VERSION is {current['protocol_version']} but "
+                f"the lock records {lock.get('protocol_version')} — "
+                "regenerate artifacts/wire_schema.lock.json "
+                "(--update-wire-lock) in the same diff as the bump",
+            )]
+
+        def rec_anchor(key: str) -> Tuple[str, int]:
+            direction, _, method = key.partition(":")
+            table = idx.response if direction == "response" else idx.request
+            rec = table.get(method)
+            return (rec.path, rec.line) if rec else (anchor_path, anchor_line)
+
+        breaking: List[Tuple[str, Tuple[str, int]]] = []
+        additive: List[str] = []
+        lock_methods = lock.get("methods", {})
+        for key, lrec in sorted(lock_methods.items()):
+            crec = current["methods"].get(key)
+            if crec is None:
+                breaking.append((
+                    f"method {key} was removed from the wire", rec_anchor(key)
+                ))
+                continue
+            lfields = {**lrec.get("required", {}), **lrec.get("optional", {})}
+            cfields = {**crec["required"], **crec["optional"]}
+            for f, types in sorted(lfields.items()):
+                if f not in cfields:
+                    breaking.append((
+                        f"{key} removed field {f!r} — old peers still "
+                        "send/expect it", rec_anchor(key),
+                    ))
+                elif sorted(cfields[f]) != sorted(types):
+                    breaking.append((
+                        f"{key} changed accepted types of {f!r} "
+                        f"({sorted(types)} -> {sorted(cfields[f])})",
+                        rec_anchor(key),
+                    ))
+                elif f in lrec.get("optional", {}) and f in crec["required"]:
+                    breaking.append((
+                        f"{key} promoted optional field {f!r} to REQUIRED "
+                        "— old peers legally omit it", rec_anchor(key),
+                    ))
+            for f in sorted(crec["required"]):
+                if f not in lfields:
+                    breaking.append((
+                        f"{key} added REQUIRED field {f!r} to an existing "
+                        "method — old peers cannot send it",
+                        rec_anchor(key),
+                    ))
+            for f in sorted(crec["optional"]):
+                if f not in lfields:
+                    additive.append(f"{key} +optional {f!r}")
+            if crec.get("since", {}) != lrec.get("since", {}):
+                additive.append(f"{key} since-map changed")
+        for key in sorted(set(current["methods"]) - set(lock_methods)):
+            additive.append(f"new method {key}")
+        for msg, (path, line) in breaking:
+            findings.append(Finding(
+                self.name, path, line,
+                f"BREAKING wire change without a PROTOCOL_VERSION bump: "
+                f"{msg}; bump PROTOCOL_VERSION and regenerate the lock "
+                "(--update-wire-lock) in the same diff",
+            ))
+        if not breaking and additive:
+            findings.append(Finding(
+                self.name, anchor_path, anchor_line,
+                "additive wire-schema drift ("
+                + "; ".join(additive[:6])
+                + ("; …" if len(additive) > 6 else "")
+                + ") — regenerate artifacts/wire_schema.lock.json "
+                "(--update-wire-lock) in this diff",
+            ))
+        return findings
+
+
+def wire_inventory(sources: Sequence[SourceFile]) -> dict:
+    """The ``--wire`` dump: per method, both schemas plus every resolved
+    sender and receiver site — the reviewable map of the control plane."""
+    model = WireModel(sources)
+    idx = model.index
+    out: Dict[str, dict] = {}
+    for method in sorted(set(idx.request) | set(idx.response)):
+        req = idx.request.get(method)
+        resp = idx.response.get(method)
+        out[method] = {
+            "request": req.as_dict() if req else None,
+            "response": resp.as_dict() if resp else None,
+            "senders": sorted(set(model.senders.get(method, []))),
+            "receivers": sorted(set(model.receivers.get(method, []))),
+        }
+    return {
+        "protocol_version": idx.protocol_version,
+        "methods": out,
+    }

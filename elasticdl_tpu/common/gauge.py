@@ -564,6 +564,35 @@ def install_jit_collector(registry: Registry) -> Callable[[], None]:
     return _collect
 
 
+# -- wiresan unknown-field bridge (v8) -------------------------------------
+
+
+def install_wire_collector(registry: Registry) -> Callable[[], None]:
+    """Expose wiresan's per-method unknown-field counts as
+    ``edl_wire_unknown_fields_total{method=...}`` on ``registry`` —
+    scrape-side, like the locksan/jitsan bridges: the counting rides the
+    rpc boundary hooks (common/wiresan.py), this only mirrors the
+    aggregates.  With ``GRAFT_WIRESAN`` unset the hooks are skipped and
+    the family simply stays empty.  A non-zero count is the version-skew
+    dashboard signal: a NEWER peer is sending fields this process's
+    schema predates — legal (additive-compat), but the operator should
+    know the fleet is mixed-version before debugging anything else.
+    Returns the collector (for ``remove_collector`` in tests)."""
+    from elasticdl_tpu.common import wiresan
+
+    def _collect() -> None:
+        for method, n in wiresan.stats()["unknown_fields"].items():
+            registry.counter(
+                "edl_wire_unknown_fields_total",
+                "unknown wire fields seen per method (wiresan; non-zero "
+                "means a newer peer is talking to this process)",
+                labels={"method": method},
+            ).set_total(n)
+
+    registry.add_collector(_collect)
+    return _collect
+
+
 # -- fleet-view helpers (jax-free; the master's aggregation math) ----------
 
 

@@ -185,6 +185,11 @@ def maybe_start(
             # edl_jit_compiles_total family joins every endpoint so a
             # production retrace shows up in watch_job, not just tests.
             gauge.install_jit_collector(registry)
+            # wiresan unknown-field counts (v8): the
+            # edl_wire_unknown_fields_total family is the mixed-version-
+            # fleet signal — a newer peer's additive fields, visible on
+            # every endpoint.
+            gauge.install_wire_collector(registry)
         return server
     except OSError:
         logger.exception(

@@ -660,13 +660,14 @@ class MasterServicer:
         if task_type == TASK_EVALUATION and self.evaluation is not None:
             # Metrics BEFORE report_task: completing the round's last task
             # snapshots the aggregate.
-            if success and req.get("metrics"):
+            eval_metrics = req.get("metrics")
+            if success and eval_metrics:
                 self.evaluation.report_metrics(
                     # Scalars coerce to float; histogram metrics (streaming
                     # AUC) arrive as lists and aggregate elementwise.
                     {
                         k: v if isinstance(v, (list, tuple)) else float(v)
-                        for k, v in req["metrics"].items()
+                        for k, v in eval_metrics.items()
                     },
                     float(req.get("weight", 1.0)),
                 )
@@ -696,16 +697,18 @@ class MasterServicer:
                     self._report_seqs[worker_id] = max(
                         self._report_seqs.get(worker_id, 0), seq
                     )
-            if success and accepted and req.get("metrics") and self.metrics_writer:
+            train_metrics = req.get("metrics")
+            if success and accepted and train_metrics and self.metrics_writer:
                 with self._lock:
                     fallback_version = self._model_version
                 self.metrics_writer.write(
                     "train",
                     int(req.get("model_version", fallback_version)),
-                    req["metrics"],
+                    train_metrics,
                 )
-        if "model_version" in req:
-            self._bump_version(int(req["model_version"]))
+        model_version = req.get("model_version")
+        if model_version is not None:
+            self._bump_version(int(model_version))
         # graftchaos (r18): kill:target=master,step=N fires HERE, after
         # the report is applied AND journaled — the crash the masterfail
         # bench injects lands exactly where a real one is hardest: a

@@ -2310,7 +2310,7 @@ class Worker:
             if resp.get("stale"):
                 return resp
             entries = resp.get("entries") or [
-                {"task": resp["task"], "finished": resp["finished"]}
+                {"task": resp.get("task"), "finished": resp["finished"]}
             ]
             self._leased.extend(
                 {"task": e["task"], "finished": e["finished"], "stale": False}
@@ -2333,7 +2333,8 @@ class Worker:
             )
             return {"task": tasks[0], "finished": False, "stale": False}
         return {
-            "task": resp["task"], "finished": resp["finished"], "stale": False
+            "task": resp.get("task"), "finished": resp["finished"],
+            "stale": False,
         }
 
     def _run_evaluation_task(self, task: Task) -> tuple:

@@ -54,6 +54,17 @@ os.environ.setdefault("GRAFT_JITSAN", "1")
 # op itself pays.  setdefault so GRAFT_CRASHSAN=0 forces it off.
 os.environ.setdefault("GRAFT_CRASHSAN", "1")
 
+# Runtime wire-schema sanitizer (common/wiresan.py) ON for the whole
+# tier-1 suite — the dynamic twin of graftlint's v8 wire passes: every
+# request AND response crossing JsonRpcClient.call / make_generic_handler
+# is validated against its MessageSchema (missing/mistyped fields raise
+# deterministically; unknown fields are counted per method — the
+# additive-compat stance).  The armed cost is one dict scan per message,
+# noise next to the JSON serialization the call already pays.  setdefault
+# so GRAFT_WIRESAN=0 forces it off; the version mask
+# (GRAFT_WIRESAN_MASK / wiresan.set_mask) stays opt-in per test.
+os.environ.setdefault("GRAFT_WIRESAN", "1")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

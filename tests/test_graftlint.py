@@ -25,6 +25,11 @@ from elasticdl_tpu.analysis.lock_discipline import LockDisciplinePass
 from elasticdl_tpu.analysis.lock_order import LockOrderPass
 from elasticdl_tpu.analysis.rpc_discipline import RpcDisciplinePass
 from elasticdl_tpu.analysis.thread_hygiene import ThreadHygienePass
+from elasticdl_tpu.analysis.wire_discipline import (
+    WireDisciplinePass,
+    WireEvolutionPass,
+    wire_fingerprint,
+)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -2507,3 +2512,266 @@ def test_cli_durables_dump():
     assert j["file"] == "master_journal.wal"
     assert any(w.endswith(" rotate") for w in j["writers"])
     assert any("read_journal" in r for r in j["recovery_readers"])
+
+
+# ---- wire-discipline (v8) ----
+
+# The schema header every v8 fixture shares: the pass EVALUATES these
+# literals (never imports them), so the fixture only has to parse.
+WIRE_HEADER = """
+    from elasticdl_tpu.common.rpc import JsonRpcClient, MessageSchema
+
+    _STR = (str,)
+    _INT = (int,)
+    _DICT = (dict,)
+
+    PROTOCOL_VERSION = 1
+
+    MASTER_SCHEMAS = {
+        "Ping": MessageSchema(
+            required={"worker_id": _STR}, optional={"lease": _INT},
+            since={"lease": 9},
+        ),
+    }
+    for _method_schema in MASTER_SCHEMAS.values():
+        _method_schema.optional.setdefault("trace", _DICT)
+        _method_schema.since.setdefault("trace", 12)
+
+    MASTER_RESPONSE_SCHEMAS = {
+        "Ping": MessageSchema(
+            required={"version": _INT}, optional={"eta": _INT},
+            since={"eta": 9},
+        ),
+    }
+"""
+
+WIRE_SENDER_SEEDED = WIRE_HEADER + """
+    def poll(client, wid):
+        return client.call("Ping", {"worker_id": wid, "leese": 1})
+"""
+
+WIRE_SENDER_CLEAN = WIRE_HEADER + """
+    def poll(client, wid):
+        payload = {"worker_id": wid}
+        payload["lease"] = 4
+        payload.setdefault("trace", {})
+        return client.call("Ping", payload)
+"""
+
+
+def test_wire_sender_undeclared_key_seeded_vs_clean():
+    findings = _lint(WIRE_SENDER_SEEDED, [WireDisciplinePass()])
+    assert _rules(findings) == {"wire-discipline"}
+    assert len(findings) == 1
+    assert "'leese'" in findings[0].message
+    # The clean twin also proves the tracked-local grammar (literal
+    # assign + const-subscript grow + setdefault) and the envelope-loop
+    # evaluation ("trace" only exists via the setdefault loop).
+    assert _lint(WIRE_SENDER_CLEAN, [WireDisciplinePass()]) == []
+
+
+WIRE_RECEIVER_SEEDED = WIRE_HEADER + """
+    class Servicer:
+        def __init__(self):
+            self._handlers = {"Ping": self._ping}
+
+        def _ping(self, req):
+            return {"version": req["lease"]}
+"""
+
+WIRE_RECEIVER_CLEAN = WIRE_HEADER + """
+    class Servicer:
+        def __init__(self):
+            self._handlers = {"Ping": self._ping}
+
+        def _ping(self, req):
+            wid = req["worker_id"]
+            return {"version": int(req.get("lease", 1)), "w": wid}
+"""
+
+
+def test_wire_receiver_optional_subscript_seeded_vs_clean():
+    findings = _lint(WIRE_RECEIVER_SEEDED, [WireDisciplinePass()])
+    assert _rules(findings) == {"wire-discipline"}
+    assert len(findings) == 1
+    assert "OPTIONAL" in findings[0].message
+    assert ".get()" in findings[0].message
+    # Clean twin: REQUIRED subscript is legal, optional via .get().
+    # NOTE the response dict's "w" key is NOT judged — only reads are.
+    assert _lint(WIRE_RECEIVER_CLEAN, [WireDisciplinePass()]) == []
+
+
+WIRE_RECEIVER_HELPER_SEEDED = WIRE_HEADER + """
+    class Servicer:
+        def __init__(self):
+            self._handlers = {"Ping": self._ping}
+
+        def _ping(self, req):
+            self._bank(req)
+            return {"version": 1}
+
+        def _bank(self, msg):
+            return msg["trace"]
+"""
+
+
+def test_wire_receiver_helper_propagation():
+    # The message param's methods flow through the same-file helper call:
+    # the optional-subscript finding lands in _bank, not _ping.
+    findings = _lint(WIRE_RECEIVER_HELPER_SEEDED, [WireDisciplinePass()])
+    assert _rules(findings) == {"wire-discipline"}
+    assert "'trace'" in findings[0].message
+
+
+WIRE_RESPONSE_SEEDED = WIRE_HEADER + """
+    def poll(client, wid):
+        resp = client.call("Ping", {"worker_id": wid})
+        return resp["eta"]
+"""
+
+WIRE_RESPONSE_CLEAN = WIRE_HEADER + """
+    def poll(client, wid):
+        resp = client.call("Ping", {"worker_id": wid})
+        return resp["version"], resp.get("eta")
+"""
+
+
+def test_wire_client_response_subscript_seeded_vs_clean():
+    findings = _lint(WIRE_RESPONSE_SEEDED, [WireDisciplinePass()])
+    assert _rules(findings) == {"wire-discipline"}
+    assert "response" in findings[0].message
+    assert _lint(WIRE_RESPONSE_CLEAN, [WireDisciplinePass()]) == []
+
+
+def test_wire_discipline_waiver_and_stale():
+    waived = WIRE_HEADER + """
+    def poll(client, wid):
+        # graftlint: allow[wire-discipline] probing the master's unknown-field counter
+        return client.call("Ping", {"worker_id": wid, "probe": 1})
+    """
+    assert _lint(waived, [WireDisciplinePass()]) == []
+    stale = WIRE_HEADER + """
+    def poll(client, wid):
+        # graftlint: allow[wire-discipline] nothing here needs this
+        return client.call("Ping", {"worker_id": wid})
+    """
+    assert _rules(_lint(stale, [WireDisciplinePass()])) == {"stale-waiver"}
+
+
+# ---- wire-evolution (v8) ----
+
+
+def _wire_sources(src: str):
+    return [SourceFile("fixture.py", textwrap.dedent(src))]
+
+
+def test_wire_evolution_clean_against_matching_lock():
+    lock = wire_fingerprint(_wire_sources(WIRE_HEADER))
+    assert lock["protocol_version"] == 1
+    assert "request:Ping" in lock["methods"]
+    # since from both the literal and the envelope loop evaluated:
+    assert lock["methods"]["request:Ping"]["since"] == {
+        "lease": 9, "trace": 12,
+    }
+    assert _lint(WIRE_HEADER, [WireEvolutionPass(lock_data=lock)]) == []
+
+
+def test_wire_evolution_breaking_drift_without_bump():
+    lock = wire_fingerprint(_wire_sources(WIRE_HEADER))
+    # The lock remembers a field the code no longer declares (= the diff
+    # REMOVED it) ...
+    lock["methods"]["request:Ping"]["optional"]["gone"] = ["str"]
+    findings = _lint(WIRE_HEADER, [WireEvolutionPass(lock_data=lock)])
+    assert _rules(findings) == {"wire-evolution"}
+    assert any("removed field 'gone'" in f.message for f in findings)
+    assert any("bump PROTOCOL_VERSION" in f.message for f in findings)
+    # ... and a type change / new REQUIRED field are the other two
+    # breaking classes.
+    lock2 = wire_fingerprint(_wire_sources(WIRE_HEADER))
+    lock2["methods"]["request:Ping"]["required"]["worker_id"] = ["int"]
+    del lock2["methods"]["response:Ping"]["required"]["version"]
+    findings2 = _lint(WIRE_HEADER, [WireEvolutionPass(lock_data=lock2)])
+    msgs = " | ".join(f.message for f in findings2)
+    assert "changed accepted types" in msgs
+    assert "added REQUIRED field 'version'" in msgs
+
+
+def test_wire_evolution_drift_with_version_bump():
+    bumped = WIRE_HEADER.replace(
+        "PROTOCOL_VERSION = 1", "PROTOCOL_VERSION = 2"
+    )
+    stale_lock = wire_fingerprint(_wire_sources(WIRE_HEADER))
+    # Bumped but the lock still records v1: ONE finding — regenerate —
+    # regardless of how breaking the drift is.
+    findings = _lint(bumped, [WireEvolutionPass(lock_data=stale_lock)])
+    assert len(findings) == 1
+    assert "regenerate" in findings[0].message
+    # Bump + regenerated lock in the same diff: clean by construction.
+    fresh_lock = wire_fingerprint(_wire_sources(bumped))
+    assert _lint(bumped, [WireEvolutionPass(lock_data=fresh_lock)]) == []
+
+
+def test_wire_evolution_additive_drift_asks_regenerate_only():
+    grown = WIRE_HEADER.replace(
+        'optional={"lease": _INT}', 'optional={"lease": _INT, "tags": _DICT}'
+    )
+    lock = wire_fingerprint(_wire_sources(WIRE_HEADER))
+    findings = _lint(grown, [WireEvolutionPass(lock_data=lock)])
+    assert len(findings) == 1
+    assert "additive" in findings[0].message
+    assert "bump" not in findings[0].message
+
+
+def test_wire_evolution_silent_on_schema_free_fixtures():
+    # Fixture files with no *_SCHEMAS tables must not drag the repo lock
+    # into every other test's lint run.
+    assert _lint(LOCK_SEEDED, [WireEvolutionPass(lock_data={})]) == []
+
+
+def test_wire_lock_matches_committed_schemas():
+    # The committed lock IS the current fingerprint — wire-evolution
+    # judges the real repo against it in test_repo_lints_clean, so a
+    # schema edit without --update-wire-lock fails tier-1 twice over.
+    from elasticdl_tpu.analysis.core import load_sources
+
+    sources, errs = load_sources(
+        [os.path.join(REPO, "elasticdl_tpu", "common", "rpc.py")],
+        rel_to=REPO,
+    )
+    assert errs == []
+    with open(os.path.join(REPO, "artifacts", "wire_schema.lock.json")) as f:
+        lock = json.load(f)
+    assert lock == wire_fingerprint(sources)
+
+
+def test_v8_passes_registered():
+    kinds = {type(p) for p in all_passes()}
+    assert WireDisciplinePass in kinds
+    assert WireEvolutionPass in kinds
+
+
+def test_cli_wire_dump():
+    out = subprocess.run(
+        [
+            sys.executable, "tools/graftlint.py", "elasticdl_tpu", "tools",
+            "--wire",
+        ],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stderr
+    doc = json.loads(out.stdout)
+    assert doc["protocol_version"] == 1
+    methods = doc["methods"]
+    assert {"GetTask", "ReportTaskResult", "Heartbeat", "Predict"} <= set(
+        methods
+    )
+    gt = methods["GetTask"]
+    assert gt["request"]["required"] == {"worker_id": ["str"]}
+    assert gt["response"]["required"] == {"finished": ["bool"]}
+    # Both resolution paths: the master's method_table form and the
+    # serving tier's dict-literal wiring.
+    assert any("servicer.py" in r for r in gt["receivers"])
+    assert any(
+        "serving/server.py" in r for r in methods["Predict"]["receivers"]
+    )
+    assert gt["senders"], "worker GetTask call site must resolve"

@@ -231,6 +231,23 @@ EXTRACTORS = {
             ),
             LOWER,
         ),
+        # v8 wire series, both zero at every healthy rev: the two
+        # wire-schema rules' repo-wide finding count, and the unknown
+        # fields the skew run's wiresan counted (a non-zero count in a
+        # SAME-VERSION run means a payload carries keys its schema never
+        # declared — exactly the silent drop wire-discipline exists to
+        # prevent).  Any climb off zero gates outright.
+        "wire_findings": (
+            (
+                float((d.get("by_rule") or {}).get("wire-discipline", 0))
+                + float((d.get("by_rule") or {}).get("wire-evolution", 0))
+            ) if isinstance(d.get("by_rule"), dict) else None,
+            LOWER,
+        ),
+        "wire_unknown_fields": (
+            (d.get("wire") or {}).get("unknown_total"),
+            LOWER,
+        ),
         **{
             f"jit_over_budget[{fn}]": (
                 max(

@@ -7,6 +7,8 @@ Usage:
     python tools/graftlint.py --callgraph        # dump the v2 call/lock graph
     python tools/graftlint.py --threadmap        # dump the v5 role map
     python tools/graftlint.py --durables         # dump the v7 durable inventory
+    python tools/graftlint.py --wire             # dump the v8 wire inventory
+    python tools/graftlint.py --update-wire-lock # regenerate the schema lock
     python tools/graftlint.py --artifact [PATH]  # stamp LINT artifact
     python tools/graftlint.py --list-rules
 
@@ -41,7 +43,7 @@ if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
 DEFAULT_PATHS = ("elasticdl_tpu", "tools")
-ARTIFACT_NAME = "LINT_r21.json"
+ARTIFACT_NAME = "LINT_r22.json"
 
 #: jitsan runtime stats (common/jitsan.py dump, GRAFT_JITSAN_DUMP) merged
 #: into the artifact when present: the static tool stays jax-free, so the
@@ -52,6 +54,13 @@ JITSAN_STATS_DEFAULT = os.path.join("artifacts", "jitsan_stats.json")
 #: artifact when present — same stance as the jitsan dump: the static tool
 #: proves the write routing, the matrix proves the crash states recover.
 CRASHSAN_MATRIX_DEFAULT = os.path.join("artifacts", "crashsan_matrix.json")
+
+#: version-skew roundtrip verdict (tools/wire_skew.py) merged into the
+#: artifact when present — same stance again: the static wire rules prove
+#: the field-access grammar, the skew run proves a v1-masked worker
+#: completes a real gRPC job against a current master with zero wire
+#: violations and zero double-trains.
+WIRE_SKEW_DEFAULT = os.path.join("artifacts", "wire_skew.json")
 
 
 def _changed_files(repo: str) -> Optional[List[str]]:
@@ -152,6 +161,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         "recovery readers) as JSON and exit",
     )
     parser.add_argument(
+        "--wire", action="store_true",
+        help="dump the v8 wire inventory (method -> request/response "
+        "schema -> sender/receiver sites) as JSON and exit",
+    )
+    parser.add_argument(
+        "--update-wire-lock", action="store_true",
+        help="regenerate artifacts/wire_schema.lock.json from the current "
+        "MessageSchema tables (the wire-evolution baseline) and exit — "
+        "run it in the SAME diff as any schema change",
+    )
+    parser.add_argument(
         "--artifact", nargs="?", const="", default=None, metavar="PATH",
         help="write a LINT artifact (findings + per-rule counts + waiver "
         "inventory + lock-graph/blocking-root stats + code_rev) via "
@@ -222,13 +242,30 @@ def main(argv: Optional[List[str]] = None) -> int:
         n_dependents = len(deps - only_paths)
         only_paths |= deps
 
+    if args.update_wire_lock:
+        # A pure regenerator: findings must not block it — the whole point
+        # is to clear a wire-evolution finding in the same diff.
+        from elasticdl_tpu.analysis.core import load_sources
+        from elasticdl_tpu.analysis.wire_discipline import (
+            WIRE_LOCK_PATH, wire_fingerprint,
+        )
+        from elasticdl_tpu.common import durable
+
+        srcs = (preloaded or load_sources(all_files, rel_to=_REPO_ROOT))[0]
+        lock_path = os.path.join(_REPO_ROOT, WIRE_LOCK_PATH)
+        durable.atomic_publish_json(
+            lock_path, wire_fingerprint(srcs), indent=1
+        )
+        print(f"wire-schema lock written to {lock_path}", file=sys.stderr)
+        return 0
+
     findings, sources = run_lint_full(
         roots, passes, rel_to=_REPO_ROOT, only_paths=only_paths,
         preloaded=preloaded,
     )
     waivers = collect_waivers(sources, only_paths=only_paths)
 
-    if args.callgraph or args.threadmap or args.durables:
+    if args.callgraph or args.threadmap or args.durables or args.wire:
         # Findings still gate the exit code — render them (stderr, so the
         # stdout JSON stays parseable) or a failing dump is undiagnosable.
         for f in findings:
@@ -237,6 +274,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             dump = _callgraph_dump(sources)
         elif args.threadmap:
             dump = _threadmap_dump(sources)
+        elif args.wire:
+            from elasticdl_tpu.analysis.wire_discipline import wire_inventory
+
+            dump = wire_inventory(sources)
         else:
             from elasticdl_tpu.analysis.durability import durables_inventory
 
@@ -334,6 +375,30 @@ def main(argv: Optional[List[str]] = None) -> int:
                     crashsan_summary = loaded.get("summary", loaded)
             except (OSError, ValueError):
                 pass  # a torn matrix file must not fail the lint artifact
+        # v8 wire section: the static inventory (methods, schemas,
+        # resolved sender/receiver sites) plus the version-skew roundtrip
+        # verdict when a tools/wire_skew.py run left one (env WIRE_SKEW
+        # overrides the default path).  bench_regress gates
+        # wire_unknown_fields at zero alongside the finding counts.
+        from elasticdl_tpu.analysis.wire_discipline import wire_inventory
+
+        skew_path = os.environ.get(
+            "WIRE_SKEW", os.path.join(_REPO_ROOT, WIRE_SKEW_DEFAULT)
+        )
+        skew_verdict = None
+        if os.path.exists(skew_path):
+            try:
+                with open(skew_path, encoding="utf-8") as f:
+                    loaded = json.load(f)
+                if isinstance(loaded, dict):
+                    skew_verdict = loaded
+            except (OSError, ValueError):
+                pass  # a torn skew dump must not fail the lint artifact
+        wire_inv = wire_inventory(sources)
+        unknown_fields = (
+            (skew_verdict.get("wiresan") or {}).get("unknown_fields") or {}
+            if skew_verdict else {}
+        )
         from elasticdl_tpu.analysis.durability import durables_inventory
 
         write_artifact(
@@ -375,6 +440,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                     ),
                 },
                 "durables": durables_inventory(sources),
+                "wire": {
+                    "protocol_version": wire_inv["protocol_version"],
+                    "methods": len(wire_inv["methods"]),
+                    "lock_file": "artifacts/wire_schema.lock.json",
+                    "unknown_total": sum(unknown_fields.values()),
+                    "skew": skew_verdict,
+                    "skew_file": (
+                        os.path.relpath(skew_path, _REPO_ROOT)
+                        if skew_verdict is not None else None
+                    ),
+                },
                 "crashsan": {
                     "summary": crashsan_summary,
                     "matrix_file": (

@@ -14,6 +14,13 @@
 # even when its declaring module didn't change) while reporting stays
 # scoped to the changed files plus their dependents.
 #
+# And the v8 wire passes (r22): both are project passes too — the
+# MessageSchema index in common/rpc.py resolves from the full file set
+# even when only a sender or handler module changed, and a schema edit
+# re-judges wire-evolution against artifacts/wire_schema.lock.json
+# (regenerate with tools/graftlint.py --update-wire-lock in the SAME
+# diff as any schema change).
+#
 # Install (from the repo root):
 #     ln -sf ../../tools/precommit.sh .git/hooks/pre-commit
 # or, to keep an existing hook, call this script from it.
